@@ -1,0 +1,21 @@
+"""predictionio_tpu — a TPU-native machine-learning serving framework.
+
+Capability parity with Apache PredictionIO (reference: /root/reference), built
+from scratch TPU-first: training and inference are JAX/XLA programs sharded
+with ``jax.sharding``/``shard_map`` over a device ``Mesh`` instead of Spark
+RDD jobs; the service plane (event server, query server, CLI) stays REST.
+
+Layer map (mirrors reference SURVEY.md §1):
+  data/      — event model, storage DAO contracts, pluggable drivers,
+               REST event server (reference: data/src/main/scala/.../data/)
+  core/      — DASE controller API + workflow executors
+               (reference: core/src/main/scala/.../{controller,workflow}/)
+  models/    — reusable algorithm library (reference: e2/ + examples/ algos)
+  ops/       — TPU compute primitives (segment ops, batched solves, Pallas)
+  parallel/  — mesh / sharding / collectives (replaces Spark shuffle)
+  serving/   — query server, batch predict (reference: workflow/CreateServer)
+  templates/ — engine templates (reference: examples/scala-parallel-*)
+  tools/     — CLI, admin server, dashboard (reference: tools/)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,13 @@
+from predictionio_tpu.data.event import Event, DataMap, PropertyMap, EventValidation
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.aggregator import aggregate_properties, PropertyAggregate
+
+__all__ = [
+    "Event",
+    "DataMap",
+    "PropertyMap",
+    "EventValidation",
+    "BiMap",
+    "aggregate_properties",
+    "PropertyAggregate",
+]

@@ -1,0 +1,428 @@
+"""Storage DAO contracts + meta-data entities.
+
+Capability parity with the reference data-access layer
+(``data/.../data/storage/``):
+
+* :class:`LEvents`  — row-oriented event DAO for serving-time lookups
+  (parity: ``LEvents.scala:40-513``; the reference's async ``future*`` methods
+  are plain sync here — callers wanting concurrency use threads).
+* :class:`PEvents`  — bulk event DAO returning columnar
+  :class:`~predictionio_tpu.data.batch.EventBatch` (parity:
+  ``PEvents.scala:38-189`` whose ``find`` returns ``RDD[Event]``).
+* :class:`Models`, :class:`Apps`, :class:`AccessKeys`, :class:`Channels`,
+  :class:`EngineInstances`, :class:`EvaluationInstances` — meta/model repos
+  (parity: ``Models.scala``, ``Apps.scala``, ``AccessKeys.scala``,
+  ``Channels.scala``, ``EngineInstances.scala``, ``EvaluationInstances.scala``).
+
+Every driver under :mod:`predictionio_tpu.data.storage` implements these
+contracts and is discovered by the env-var registry (``registry.py``), keeping
+the reference's ``PIO_STORAGE_*`` configuration contract.
+"""
+
+from __future__ import annotations
+
+import abc
+import datetime as _dt
+import re
+import secrets
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+from predictionio_tpu.data.aggregator import PropertyAggregate
+from predictionio_tpu.data.batch import EventBatch
+from predictionio_tpu.data.event import Event, EventValidation, PropertyMap
+
+# ---------------------------------------------------------------------------
+# Meta-data entities
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class App:
+    """Parity: ``Apps.scala`` case class App(id, name, description)."""
+
+    id: int
+    name: str
+    description: Optional[str] = None
+
+
+@dataclass
+class AccessKey:
+    """Parity: ``AccessKeys.scala`` (key, appid, events whitelist)."""
+
+    key: str
+    app_id: int
+    events: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Channel:
+    """Parity: ``Channels.scala`` (id, name, appid) + name validation."""
+
+    id: int
+    name: str
+    app_id: int
+
+    NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+
+    @classmethod
+    def is_valid_name(cls, s: str) -> bool:
+        return bool(cls.NAME_RE.match(s))
+
+
+@dataclass
+class EngineInstance:
+    """One train run's record (parity: ``EngineInstances.scala``).
+
+    Status lifecycle INIT → TRAINING → COMPLETED mirrors
+    ``CreateWorkflow.scala:229`` / ``CoreWorkflow.scala:85-88``; ``deploy``
+    only accepts COMPLETED instances (``commands/Engine.scala:234-241``).
+    ``mesh_conf`` replaces the reference's ``sparkConf`` blob.
+    """
+
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: _dt.datetime
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: dict = field(default_factory=dict)
+    mesh_conf: dict = field(default_factory=dict)
+    data_source_params: str = ""
+    preparator_params: str = ""
+    algorithms_params: str = ""
+    serving_params: str = ""
+
+
+@dataclass
+class EvaluationInstance:
+    """Parity: ``EvaluationInstances.scala``."""
+
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: _dt.datetime
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: dict = field(default_factory=dict)
+    mesh_conf: dict = field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclass
+class Model:
+    """Serialized model blob (parity: ``Models.scala`` Model(id, models))."""
+
+    id: str
+    models: bytes
+
+
+# ---------------------------------------------------------------------------
+# Event DAO contracts
+# ---------------------------------------------------------------------------
+
+
+class LEvents(abc.ABC):
+    """Row-oriented event store: inserts, point reads, filtered scans."""
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Initialize storage for an (app, channel) namespace."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Drop all events of the namespace."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        """Insert one event, returning its eventId."""
+
+    def batch_insert(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> list[str]:
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]: ...
+
+    @abc.abstractmethod
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool: ...
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterable[Event]:
+        """Filtered scan ordered by event_time (parity: LEvents.futureFind).
+
+        ``limit=None`` means all; ``reversed=True`` returns latest first.
+        A ``target_entity_type``/``target_entity_id`` of the string "None"
+        filters for events WITHOUT a target (reference quirk preserved at the
+        HTTP layer, see EventServer).
+        """
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> dict[str, PropertyMap]:
+        """Fold $set/$unset/$delete into snapshots (parity: LEvents:~430)."""
+        events = self.find(
+            app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=sorted(EventValidation.SPECIAL_EVENTS),
+        )
+        per_entity: dict[str, list[Event]] = {}
+        for e in events:
+            per_entity.setdefault(e.entity_id, []).append(e)
+        out: dict[str, PropertyMap] = {}
+        for entity_id, evs in per_entity.items():
+            evs.sort(key=lambda e: (e.event_time, e.creation_time))
+            agg = PropertyAggregate()
+            for e in evs:
+                agg = agg.update(e)
+            pm = agg.to_property_map()
+            if pm is None:
+                continue
+            if required and not all(k in pm for k in required):
+                continue
+            out[entity_id] = pm
+        return out
+
+
+class PEvents(abc.ABC):
+    """Bulk/columnar event store (parity: ``PEvents.scala:38-189``).
+
+    Where the reference returns ``RDD[Event]`` for Spark executors, this
+    returns an :class:`EventBatch` (structure-of-arrays) ready for vectorized
+    indexing and device placement.
+    """
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+    ) -> EventBatch: ...
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> dict[str, PropertyMap]:
+        batch = self.find(
+            app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=sorted(EventValidation.SPECIAL_EVENTS),
+        )
+        per_entity: dict[str, list[Event]] = {}
+        for e in batch:
+            per_entity.setdefault(e.entity_id, []).append(e)
+        out: dict[str, PropertyMap] = {}
+        for entity_id, evs in per_entity.items():
+            evs.sort(key=lambda ev: (ev.event_time, ev.creation_time))
+            agg = PropertyAggregate()
+            for e in evs:
+                agg = agg.update(e)
+            pm = agg.to_property_map()
+            if pm is None:
+                continue
+            if required and not all(k in pm for k in required):
+                continue
+            out[entity_id] = pm
+        return out
+
+    @abc.abstractmethod
+    def write(
+        self, events: Iterable[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> None:
+        """Bulk write (parity: PEvents.write)."""
+
+    @abc.abstractmethod
+    def delete(
+        self, event_ids: Iterable[str], app_id: int, channel_id: Optional[int] = None
+    ) -> None:
+        """Bulk delete by eventId (parity: PEvents.delete)."""
+
+
+# ---------------------------------------------------------------------------
+# Meta-data DAO contracts
+# ---------------------------------------------------------------------------
+
+
+class Models(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, model_id: str) -> Optional[Model]: ...
+
+    @abc.abstractmethod
+    def delete(self, model_id: str) -> None: ...
+
+
+class Apps(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]:
+        """Insert, returning the assigned id (app.id==0 ⇒ auto-assign)."""
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+class AccessKeys(abc.ABC):
+    @staticmethod
+    def generate_key() -> str:
+        return secrets.token_urlsafe(48)
+
+    @abc.abstractmethod
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        """Insert, generating the key string if empty; returns the key."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, access_key: AccessKey) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+
+class Channels(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> Optional[int]: ...
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> list[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineInstances(abc.ABC):
+    STATUS_INIT = "INIT"
+    STATUS_TRAINING = "TRAINING"
+    STATUS_COMPLETED = "COMPLETED"
+
+    @abc.abstractmethod
+    def insert(self, instance: EngineInstance) -> str:
+        """Insert, assigning id if empty; returns id."""
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EngineInstance]: ...
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        """Parity: EngineInstances.getLatestCompleted — newest COMPLETED run."""
+        candidates = [
+            i
+            for i in self.get_completed(engine_id, engine_version, engine_variant)
+        ]
+        return candidates[0] if candidates else None
+
+    @abc.abstractmethod
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]:
+        """COMPLETED instances, newest first."""
+
+    @abc.abstractmethod
+    def update(self, instance: EngineInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class EvaluationInstances(abc.ABC):
+    STATUS_INIT = "INIT"
+    STATUS_EVALUATING = "EVALUATING"
+    STATUS_COMPLETED = "EVALCOMPLETED"
+
+    @abc.abstractmethod
+    def insert(self, instance: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> list[EvaluationInstance]:
+        """Completed evaluations, newest first."""
+
+    @abc.abstractmethod
+    def update(self, instance: EvaluationInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...

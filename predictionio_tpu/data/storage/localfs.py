@@ -1,0 +1,47 @@
+"""Local-filesystem model store (reference: storage/localfs/LocalFSModels.scala).
+
+Stores model blobs as files under ``PIO_FS_BASEDIR`` (default
+``~/.pio_store/models``), one file per model id.  The reference's HDFS and S3
+drivers play the same role with a different filesystem; an S3-compatible
+driver can reuse this contract.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from predictionio_tpu.data.storage import base
+
+
+class LocalFSModels(base.Models):
+    def __init__(self, source_name: str = "default", path: Optional[str] = None, **_):
+        if path is None:
+            base_dir = os.environ.get(
+                "PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store")
+            )
+            path = os.path.join(base_dir, "models", source_name)
+        self._dir = path
+        os.makedirs(self._dir, exist_ok=True)
+
+    def _path(self, model_id: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in model_id)
+        return os.path.join(self._dir, safe)
+
+    def insert(self, model: base.Model) -> None:
+        tmp = self._path(model.id) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(model.models)
+        os.replace(tmp, self._path(model.id))
+
+    def get(self, model_id: str):
+        p = self._path(model_id)
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return base.Model(model_id, f.read())
+
+    def delete(self, model_id: str) -> None:
+        p = self._path(model_id)
+        if os.path.exists(p):
+            os.remove(p)

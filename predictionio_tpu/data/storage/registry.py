@@ -1,0 +1,203 @@
+"""Storage registry: env-var configured, pluggable driver discovery.
+
+Parity: ``data/.../data/storage/Storage.scala:146-466``.  The configuration
+contract is preserved verbatim:
+
+* ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` — driver type of source <NAME>
+  (supported here: ``memory``, ``sqlite`` (alias ``jdbc``), ``localfs``);
+  any other key after the type becomes a constructor kwarg, e.g.
+  ``PIO_STORAGE_SOURCES_PGSQL_PATH=/data/pio.sqlite`` → ``path=...``
+  (parity: Storage.scala:158-223 sourcesPrefixFilter).
+* ``PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}``
+  — binds each repository to a named source.
+
+Where the reference resolves DAO classes reflectively from the JVM classpath
+(``Storage.getDataObject:310-359``), drivers here register in
+:data:`DRIVERS` (extensible at runtime via :func:`register_driver`, the
+Python-native replacement for classpath scanning).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Optional
+
+from predictionio_tpu.data.storage import base
+
+logger = logging.getLogger(__name__)
+
+METADATA = "METADATA"
+EVENTDATA = "EVENTDATA"
+MODELDATA = "MODELDATA"
+
+# driver type → DAO name → factory(source_name, **kwargs)
+DRIVERS: dict[str, dict[str, Callable]] = {}
+
+
+def register_driver(type_name: str, daos: dict[str, Callable]) -> None:
+    DRIVERS.setdefault(type_name, {}).update(daos)
+
+
+def _register_builtin():
+    from predictionio_tpu.data.storage import localfs, memory, sqlite
+
+    register_driver(
+        "memory",
+        {
+            "LEvents": memory.MemoryLEvents,
+            "PEvents": memory.MemoryPEvents,
+            "Models": memory.MemoryModels,
+            "Apps": memory.MemoryApps,
+            "AccessKeys": memory.MemoryAccessKeys,
+            "Channels": memory.MemoryChannels,
+            "EngineInstances": memory.MemoryEngineInstances,
+            "EvaluationInstances": memory.MemoryEvaluationInstances,
+        },
+    )
+    sqlite_daos = {
+        "LEvents": sqlite.SqliteLEvents,
+        "PEvents": sqlite.SqlitePEvents,
+        "Models": sqlite.SqliteModels,
+        "Apps": sqlite.SqliteApps,
+        "AccessKeys": sqlite.SqliteAccessKeys,
+        "Channels": sqlite.SqliteChannels,
+        "EngineInstances": sqlite.SqliteEngineInstances,
+        "EvaluationInstances": sqlite.SqliteEvaluationInstances,
+    }
+    register_driver("sqlite", sqlite_daos)
+    register_driver("jdbc", sqlite_daos)  # config-compat alias
+    register_driver("localfs", {"Models": localfs.LocalFSModels})
+
+
+_register_builtin()
+
+
+class StorageError(Exception):
+    pass
+
+
+class Storage:
+    """Facade over the configured sources/repositories (object Storage)."""
+
+    _instance: Optional["Storage"] = None
+
+    def __init__(self, env: Optional[dict] = None):
+        self.env = dict(env) if env is not None else dict(os.environ)
+        self._sources = self._parse_sources()
+        self._repos = self._parse_repositories()
+        self._dao_cache: dict[tuple[str, str], object] = {}
+
+    # Singleton used by services; tests construct their own with fake env.
+    @classmethod
+    def instance(cls) -> "Storage":
+        if cls._instance is None:
+            cls._instance = Storage()
+        return cls._instance
+
+    @classmethod
+    def reset_instance(cls) -> None:
+        cls._instance = None
+
+    # -- env parsing (parity: Storage.scala:158-223) -----------------------
+    def _parse_sources(self) -> dict[str, dict]:
+        prefix = "PIO_STORAGE_SOURCES_"
+        sources: dict[str, dict] = {}
+        for k, v in self.env.items():
+            if not k.startswith(prefix):
+                continue
+            rest = k[len(prefix):]
+            if "_" not in rest:
+                continue
+            name, attr = rest.split("_", 1)
+            sources.setdefault(name, {})[attr.lower()] = v
+        out = {}
+        for name, attrs in sources.items():
+            if "type" not in attrs:
+                logger.warning("storage source %s has no TYPE; ignored", name)
+                continue
+            out[name] = attrs
+        if not out:
+            # Zero-config default: sqlite under PIO_FS_BASEDIR.
+            out["DEFAULT"] = {"type": "sqlite"}
+        return out
+
+    def _parse_repositories(self) -> dict[str, str]:
+        repos: dict[str, str] = {}
+        for repo in (METADATA, EVENTDATA, MODELDATA):
+            src = self.env.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE")
+            if src is None:
+                src = next(iter(self._sources))
+            if src not in self._sources:
+                raise StorageError(
+                    f"repository {repo} references undefined source {src}"
+                )
+            repos[repo] = src
+        return repos
+
+    # -- DAO resolution (parity: Storage.getDataObject:310-359) ------------
+    def get_data_object(self, repo: str, dao: str):
+        key = (repo, dao)
+        if key in self._dao_cache:
+            return self._dao_cache[key]
+        source_name = self._repos[repo]
+        attrs = dict(self._sources[source_name])
+        type_name = attrs.pop("type")
+        if type_name not in DRIVERS:
+            raise StorageError(f"unknown storage type {type_name!r}")
+        if dao not in DRIVERS[type_name]:
+            raise StorageError(
+                f"storage type {type_name!r} does not implement {dao} "
+                f"(required by repository {repo})"
+            )
+        obj = DRIVERS[type_name][dao](source_name=source_name, **attrs)
+        self._dao_cache[key] = obj
+        return obj
+
+    # -- typed accessors (parity: Storage.getMetaDataApps etc.) ------------
+    def get_l_events(self) -> base.LEvents:
+        return self.get_data_object(EVENTDATA, "LEvents")
+
+    def get_p_events(self) -> base.PEvents:
+        return self.get_data_object(EVENTDATA, "PEvents")
+
+    def get_model_data_models(self) -> base.Models:
+        return self.get_data_object(MODELDATA, "Models")
+
+    def get_meta_data_apps(self) -> base.Apps:
+        return self.get_data_object(METADATA, "Apps")
+
+    def get_meta_data_access_keys(self) -> base.AccessKeys:
+        return self.get_data_object(METADATA, "AccessKeys")
+
+    def get_meta_data_channels(self) -> base.Channels:
+        return self.get_data_object(METADATA, "Channels")
+
+    def get_meta_data_engine_instances(self) -> base.EngineInstances:
+        return self.get_data_object(METADATA, "EngineInstances")
+
+    def get_meta_data_evaluation_instances(self) -> base.EvaluationInstances:
+        return self.get_data_object(METADATA, "EvaluationInstances")
+
+    # -- smoke check (parity: Storage.verifyAllDataObjects:372-394) --------
+    def verify_all_data_objects(self) -> bool:
+        """Touch every repository + write/read/delete one test event."""
+        from predictionio_tpu.data.event import Event
+
+        self.get_meta_data_apps()
+        self.get_meta_data_access_keys()
+        self.get_meta_data_channels()
+        self.get_meta_data_engine_instances()
+        self.get_meta_data_evaluation_instances()
+        self.get_model_data_models()
+        levents = self.get_l_events()
+        levents.init(0)
+        eid = levents.insert(
+            Event(event="$set", entity_type="pio_pr", entity_id="1",
+                  properties={"pio_storage_verification": True}),
+            0,
+        )
+        ok = levents.get(eid, 0) is not None
+        levents.delete(eid, 0)
+        levents.remove(0)
+        return ok

@@ -1,0 +1,691 @@
+"""SQLite storage driver — the relational backend (reference: storage/jdbc/).
+
+Implements all three repositories (METADATA, EVENTDATA, MODELDATA) the way the
+reference's JDBC driver does (``storage/jdbc/.../JDBC{LEvents,PEvents,Models,
+Apps,AccessKeys,Channels,EngineInstances,EvaluationInstances}.scala``), with
+filter predicates pushed into SQL like ``JDBCPEvents.find``
+(``JDBCPEvents.scala:35-119``).  One file-backed database per source; WAL mode
+so the event server's concurrent writers and the trainer's bulk reader
+coexist.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import sqlite3
+import threading
+from typing import Iterable, Optional, Sequence
+
+from predictionio_tpu.data.batch import EventBatch
+from predictionio_tpu.data.event import DataMap, Event, new_event_id
+from predictionio_tpu.data.storage import base
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS events (
+  id TEXT NOT NULL, app_id INTEGER NOT NULL, channel_id INTEGER NOT NULL,
+  event TEXT NOT NULL, entity_type TEXT NOT NULL, entity_id TEXT NOT NULL,
+  target_entity_type TEXT, target_entity_id TEXT,
+  properties TEXT NOT NULL, event_time REAL NOT NULL,
+  tags TEXT NOT NULL, pr_id TEXT, creation_time REAL NOT NULL,
+  PRIMARY KEY (id, app_id, channel_id));
+CREATE INDEX IF NOT EXISTS idx_events_scan
+  ON events (app_id, channel_id, event_time);
+CREATE INDEX IF NOT EXISTS idx_events_entity
+  ON events (app_id, channel_id, entity_type, entity_id);
+CREATE TABLE IF NOT EXISTS apps (
+  id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT UNIQUE NOT NULL,
+  description TEXT);
+CREATE TABLE IF NOT EXISTS access_keys (
+  key TEXT PRIMARY KEY, app_id INTEGER NOT NULL, events TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS channels (
+  id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT NOT NULL,
+  app_id INTEGER NOT NULL);
+CREATE TABLE IF NOT EXISTS engine_instances (
+  id TEXT PRIMARY KEY, status TEXT, start_time REAL, end_time REAL,
+  engine_id TEXT, engine_version TEXT, engine_variant TEXT,
+  engine_factory TEXT, batch TEXT, env TEXT, mesh_conf TEXT,
+  data_source_params TEXT, preparator_params TEXT, algorithms_params TEXT,
+  serving_params TEXT);
+CREATE TABLE IF NOT EXISTS evaluation_instances (
+  id TEXT PRIMARY KEY, status TEXT, start_time REAL, end_time REAL,
+  evaluation_class TEXT, engine_params_generator_class TEXT, batch TEXT,
+  env TEXT, mesh_conf TEXT, evaluator_results TEXT,
+  evaluator_results_html TEXT, evaluator_results_json TEXT);
+CREATE TABLE IF NOT EXISTS models (id TEXT PRIMARY KEY, models BLOB NOT NULL);
+"""
+
+_CONNS: dict[str, "_Db"] = {}
+_CONNS_LOCK = threading.Lock()
+
+
+class _Db:
+    def __init__(self, path: str):
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.lock = threading.RLock()
+        with self.lock:
+            if path != ":memory:":
+                self.conn.execute("PRAGMA journal_mode=WAL")
+            self.conn.execute("PRAGMA synchronous=NORMAL")
+            self.conn.executescript(_SCHEMA)
+            self.conn.commit()
+
+
+def get_db(path: str) -> _Db:
+    key = os.path.abspath(path) if path != ":memory:" else ":memory:"
+    with _CONNS_LOCK:
+        if key not in _CONNS:
+            _CONNS[key] = _Db(path)
+        return _CONNS[key]
+
+
+def _default_path(source_name: str) -> str:
+    base_dir = os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+    return os.path.join(base_dir, f"{source_name.lower()}.sqlite")
+
+
+class _SqliteDAO:
+    def __init__(self, source_name: str = "default", path: Optional[str] = None, **_):
+        self._db = get_db(path or _default_path(source_name))
+
+    @property
+    def conn(self):
+        return self._db.conn
+
+    @property
+    def lock(self):
+        return self._db.lock
+
+
+def _chan(channel_id: Optional[int]) -> int:
+    return 0 if channel_id is None else channel_id
+
+
+def _ts(d: _dt.datetime) -> float:
+    """Epoch seconds; naive datetimes are interpreted as UTC (never local)."""
+    if d.tzinfo is None:
+        d = d.replace(tzinfo=_dt.timezone.utc)
+    return d.timestamp()
+
+
+def _row_to_event(r) -> Event:
+    return Event(
+        event=r[3],
+        entity_type=r[4],
+        entity_id=r[5],
+        target_entity_type=r[6],
+        target_entity_id=r[7],
+        properties=DataMap(json.loads(r[8])),
+        event_time=_dt.datetime.fromtimestamp(r[9], tz=_dt.timezone.utc),
+        tags=tuple(json.loads(r[10])),
+        pr_id=r[11],
+        event_id=r[0],
+        creation_time=_dt.datetime.fromtimestamp(r[12], tz=_dt.timezone.utc),
+    )
+
+
+def _event_where(
+    app_id,
+    channel_id,
+    start_time=None,
+    until_time=None,
+    entity_type=None,
+    entity_id=None,
+    event_names=None,
+    target_entity_type=None,
+    target_entity_id=None,
+):
+    """Build the SQL predicate (parity: JDBCPEvents.find pushdown)."""
+    clauses = ["app_id = ?", "channel_id = ?"]
+    params: list = [app_id, _chan(channel_id)]
+    if start_time is not None:
+        clauses.append("event_time >= ?")
+        params.append(_ts(start_time))
+    if until_time is not None:
+        clauses.append("event_time < ?")
+        params.append(_ts(until_time))
+    if entity_type is not None:
+        clauses.append("entity_type = ?")
+        params.append(entity_type)
+    if entity_id is not None:
+        clauses.append("entity_id = ?")
+        params.append(entity_id)
+    if event_names is not None:
+        if len(event_names) == 0:
+            clauses.append("1 = 0")  # empty IN-list matches nothing
+        else:
+            clauses.append(f"event IN ({','.join('?' * len(event_names))})")
+            params.extend(event_names)
+    if target_entity_type is not None:
+        if target_entity_type == "None":
+            clauses.append("target_entity_type IS NULL")
+        else:
+            clauses.append("target_entity_type = ?")
+            params.append(target_entity_type)
+    if target_entity_id is not None:
+        if target_entity_id == "None":
+            clauses.append("target_entity_id IS NULL")
+        else:
+            clauses.append("target_entity_id = ?")
+            params.append(target_entity_id)
+    return " AND ".join(clauses), params
+
+
+class SqliteLEvents(_SqliteDAO, base.LEvents):
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return True  # single-table layout; nothing to create per namespace
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self.lock:
+            self.conn.execute(
+                "DELETE FROM events WHERE app_id = ? AND channel_id = ?",
+                (app_id, _chan(channel_id)),
+            )
+            self.conn.commit()
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        eid = event.event_id or new_event_id()
+        with self.lock:
+            self.conn.execute(
+                "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    eid,
+                    app_id,
+                    _chan(channel_id),
+                    event.event,
+                    event.entity_type,
+                    event.entity_id,
+                    event.target_entity_type,
+                    event.target_entity_id,
+                    json.dumps(event.properties.to_dict()),
+                    _ts(event.event_time),
+                    json.dumps(list(event.tags)),
+                    event.pr_id,
+                    _ts(event.creation_time),
+                ),
+            )
+            self.conn.commit()
+        return eid
+
+    def batch_insert(self, events, app_id, channel_id=None):
+        ids = []
+        rows = []
+        for event in events:
+            eid = event.event_id or new_event_id()
+            ids.append(eid)
+            rows.append(
+                (
+                    eid,
+                    app_id,
+                    _chan(channel_id),
+                    event.event,
+                    event.entity_type,
+                    event.entity_id,
+                    event.target_entity_type,
+                    event.target_entity_id,
+                    json.dumps(event.properties.to_dict()),
+                    _ts(event.event_time),
+                    json.dumps(list(event.tags)),
+                    event.pr_id,
+                    _ts(event.creation_time),
+                )
+            )
+        with self.lock:
+            self.conn.executemany(
+                "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows
+            )
+            self.conn.commit()
+        return ids
+
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None):
+        with self.lock:
+            r = self.conn.execute(
+                "SELECT * FROM events WHERE id = ? AND app_id = ? AND channel_id = ?",
+                (event_id, app_id, _chan(channel_id)),
+            ).fetchone()
+        return _row_to_event(r) if r else None
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self.lock:
+            cur = self.conn.execute(
+                "DELETE FROM events WHERE id = ? AND app_id = ? AND channel_id = ?",
+                (event_id, app_id, _chan(channel_id)),
+            )
+            self.conn.commit()
+        return cur.rowcount > 0
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time=None,
+        until_time=None,
+        entity_type=None,
+        entity_id=None,
+        event_names=None,
+        target_entity_type=None,
+        target_entity_id=None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterable[Event]:
+        where, params = _event_where(
+            app_id,
+            channel_id,
+            start_time,
+            until_time,
+            entity_type,
+            entity_id,
+            event_names,
+            target_entity_type,
+            target_entity_id,
+        )
+        order = "DESC" if reversed else "ASC"
+        sql = f"SELECT * FROM events WHERE {where} ORDER BY event_time {order}, creation_time {order}"
+        if limit is not None and limit >= 0:
+            sql += f" LIMIT {int(limit)}"
+        with self.lock:
+            rows = self.conn.execute(sql, params).fetchall()
+        return [_row_to_event(r) for r in rows]
+
+
+class SqlitePEvents(_SqliteDAO, base.PEvents):
+    def __init__(self, source_name: str = "default", path: Optional[str] = None, **kw):
+        super().__init__(source_name=source_name, path=path, **kw)
+        self._l = SqliteLEvents(source_name=source_name, path=path, **kw)
+
+    def find(self, app_id, channel_id=None, **filters) -> EventBatch:
+        return EventBatch.from_events(self._l.find(app_id, channel_id, **filters))
+
+    def write(self, events: Iterable[Event], app_id: int, channel_id=None) -> None:
+        self._l.batch_insert(list(events), app_id, channel_id)
+
+    def delete(self, event_ids: Iterable[str], app_id: int, channel_id=None) -> None:
+        with self.lock:
+            self.conn.executemany(
+                "DELETE FROM events WHERE id = ? AND app_id = ? AND channel_id = ?",
+                [(eid, app_id, _chan(channel_id)) for eid in event_ids],
+            )
+            self.conn.commit()
+
+
+class SqliteModels(_SqliteDAO, base.Models):
+    def insert(self, model: base.Model) -> None:
+        with self.lock:
+            self.conn.execute(
+                "INSERT OR REPLACE INTO models VALUES (?, ?)", (model.id, model.models)
+            )
+            self.conn.commit()
+
+    def get(self, model_id: str):
+        with self.lock:
+            r = self.conn.execute(
+                "SELECT id, models FROM models WHERE id = ?", (model_id,)
+            ).fetchone()
+        return base.Model(r[0], r[1]) if r else None
+
+    def delete(self, model_id: str) -> None:
+        with self.lock:
+            self.conn.execute("DELETE FROM models WHERE id = ?", (model_id,))
+            self.conn.commit()
+
+
+class SqliteApps(_SqliteDAO, base.Apps):
+    def insert(self, app: base.App):
+        with self.lock:
+            try:
+                if app.id > 0:
+                    cur = self.conn.execute(
+                        "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
+                        (app.id, app.name, app.description),
+                    )
+                else:
+                    cur = self.conn.execute(
+                        "INSERT INTO apps (name, description) VALUES (?,?)",
+                        (app.name, app.description),
+                    )
+                self.conn.commit()
+                return cur.lastrowid if app.id <= 0 else app.id
+            except sqlite3.IntegrityError:
+                return None
+
+    def get(self, app_id: int):
+        with self.lock:
+            r = self.conn.execute(
+                "SELECT id, name, description FROM apps WHERE id = ?", (app_id,)
+            ).fetchone()
+        return base.App(*r) if r else None
+
+    def get_by_name(self, name: str):
+        with self.lock:
+            r = self.conn.execute(
+                "SELECT id, name, description FROM apps WHERE name = ?", (name,)
+            ).fetchone()
+        return base.App(*r) if r else None
+
+    def get_all(self):
+        with self.lock:
+            rows = self.conn.execute(
+                "SELECT id, name, description FROM apps ORDER BY id"
+            ).fetchall()
+        return [base.App(*r) for r in rows]
+
+    def update(self, app: base.App) -> bool:
+        with self.lock:
+            cur = self.conn.execute(
+                "UPDATE apps SET name = ?, description = ? WHERE id = ?",
+                (app.name, app.description, app.id),
+            )
+            self.conn.commit()
+        return cur.rowcount > 0
+
+    def delete(self, app_id: int) -> bool:
+        with self.lock:
+            cur = self.conn.execute("DELETE FROM apps WHERE id = ?", (app_id,))
+            self.conn.commit()
+        return cur.rowcount > 0
+
+
+class SqliteAccessKeys(_SqliteDAO, base.AccessKeys):
+    def insert(self, access_key: base.AccessKey):
+        key = access_key.key or self.generate_key()
+        with self.lock:
+            try:
+                self.conn.execute(
+                    "INSERT INTO access_keys VALUES (?,?,?)",
+                    (key, access_key.app_id, json.dumps(list(access_key.events))),
+                )
+                self.conn.commit()
+                return key
+            except sqlite3.IntegrityError:
+                return None
+
+    def _row(self, r):
+        return base.AccessKey(r[0], r[1], json.loads(r[2]))
+
+    def get(self, key: str):
+        with self.lock:
+            r = self.conn.execute(
+                "SELECT * FROM access_keys WHERE key = ?", (key,)
+            ).fetchone()
+        return self._row(r) if r else None
+
+    def get_all(self):
+        with self.lock:
+            rows = self.conn.execute("SELECT * FROM access_keys").fetchall()
+        return [self._row(r) for r in rows]
+
+    def get_by_app_id(self, app_id: int):
+        with self.lock:
+            rows = self.conn.execute(
+                "SELECT * FROM access_keys WHERE app_id = ?", (app_id,)
+            ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def update(self, access_key: base.AccessKey) -> bool:
+        with self.lock:
+            cur = self.conn.execute(
+                "UPDATE access_keys SET app_id = ?, events = ? WHERE key = ?",
+                (access_key.app_id, json.dumps(list(access_key.events)), access_key.key),
+            )
+            self.conn.commit()
+        return cur.rowcount > 0
+
+    def delete(self, key: str) -> bool:
+        with self.lock:
+            cur = self.conn.execute("DELETE FROM access_keys WHERE key = ?", (key,))
+            self.conn.commit()
+        return cur.rowcount > 0
+
+
+class SqliteChannels(_SqliteDAO, base.Channels):
+    def insert(self, channel: base.Channel):
+        if not base.Channel.is_valid_name(channel.name):
+            return None
+        with self.lock:
+            try:
+                if channel.id > 0:
+                    self.conn.execute(
+                        "INSERT INTO channels (id, name, app_id) VALUES (?,?,?)",
+                        (channel.id, channel.name, channel.app_id),
+                    )
+                    self.conn.commit()
+                    return channel.id
+                cur = self.conn.execute(
+                    "INSERT INTO channels (name, app_id) VALUES (?,?)",
+                    (channel.name, channel.app_id),
+                )
+                self.conn.commit()
+                return cur.lastrowid
+            except sqlite3.IntegrityError:
+                return None
+
+    def get(self, channel_id: int):
+        with self.lock:
+            r = self.conn.execute(
+                "SELECT id, name, app_id FROM channels WHERE id = ?", (channel_id,)
+            ).fetchone()
+        return base.Channel(*r) if r else None
+
+    def get_by_app_id(self, app_id: int):
+        with self.lock:
+            rows = self.conn.execute(
+                "SELECT id, name, app_id FROM channels WHERE app_id = ?", (app_id,)
+            ).fetchall()
+        return [base.Channel(*r) for r in rows]
+
+    def delete(self, channel_id: int) -> bool:
+        with self.lock:
+            cur = self.conn.execute("DELETE FROM channels WHERE id = ?", (channel_id,))
+            self.conn.commit()
+        return cur.rowcount > 0
+
+
+def _dt_from(ts: float) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(ts, tz=_dt.timezone.utc)
+
+
+class SqliteEngineInstances(_SqliteDAO, base.EngineInstances):
+    _COLS = (
+        "id, status, start_time, end_time, engine_id, engine_version, "
+        "engine_variant, engine_factory, batch, env, mesh_conf, "
+        "data_source_params, preparator_params, algorithms_params, serving_params"
+    )
+
+    def _row(self, r) -> base.EngineInstance:
+        return base.EngineInstance(
+            id=r[0],
+            status=r[1],
+            start_time=_dt_from(r[2]),
+            end_time=_dt_from(r[3]),
+            engine_id=r[4],
+            engine_version=r[5],
+            engine_variant=r[6],
+            engine_factory=r[7],
+            batch=r[8],
+            env=json.loads(r[9]),
+            mesh_conf=json.loads(r[10]),
+            data_source_params=r[11],
+            preparator_params=r[12],
+            algorithms_params=r[13],
+            serving_params=r[14],
+        )
+
+    def _vals(self, i: base.EngineInstance):
+        return (
+            i.id,
+            i.status,
+            _ts(i.start_time),
+            _ts(i.end_time),
+            i.engine_id,
+            i.engine_version,
+            i.engine_variant,
+            i.engine_factory,
+            i.batch,
+            json.dumps(i.env),
+            json.dumps(i.mesh_conf),
+            i.data_source_params,
+            i.preparator_params,
+            i.algorithms_params,
+            i.serving_params,
+        )
+
+    def insert(self, instance: base.EngineInstance) -> str:
+        import secrets
+
+        instance.id = instance.id or secrets.token_hex(8)
+        with self.lock:
+            self.conn.execute(
+                f"INSERT OR REPLACE INTO engine_instances VALUES ({','.join('?' * 15)})",
+                self._vals(instance),
+            )
+            self.conn.commit()
+        return instance.id
+
+    def get(self, instance_id: str):
+        with self.lock:
+            r = self.conn.execute(
+                f"SELECT {self._COLS} FROM engine_instances WHERE id = ?",
+                (instance_id,),
+            ).fetchone()
+        return self._row(r) if r else None
+
+    def get_all(self):
+        with self.lock:
+            rows = self.conn.execute(
+                f"SELECT {self._COLS} FROM engine_instances"
+            ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        with self.lock:
+            rows = self.conn.execute(
+                f"SELECT {self._COLS} FROM engine_instances WHERE status = ? AND "
+                "engine_id = ? AND engine_version = ? AND engine_variant = ? "
+                "ORDER BY start_time DESC",
+                (self.STATUS_COMPLETED, engine_id, engine_version, engine_variant),
+            ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def update(self, instance: base.EngineInstance) -> bool:
+        with self.lock:
+            cur = self.conn.execute(
+                "UPDATE engine_instances SET status=?, start_time=?, end_time=?, "
+                "engine_id=?, engine_version=?, engine_variant=?, engine_factory=?, "
+                "batch=?, env=?, mesh_conf=?, data_source_params=?, "
+                "preparator_params=?, algorithms_params=?, serving_params=? "
+                "WHERE id=?",
+                self._vals(instance)[1:] + (instance.id,),
+            )
+            self.conn.commit()
+        return cur.rowcount > 0
+
+    def delete(self, instance_id: str) -> bool:
+        with self.lock:
+            cur = self.conn.execute(
+                "DELETE FROM engine_instances WHERE id = ?", (instance_id,)
+            )
+            self.conn.commit()
+        return cur.rowcount > 0
+
+
+class SqliteEvaluationInstances(_SqliteDAO, base.EvaluationInstances):
+    _COLS = (
+        "id, status, start_time, end_time, evaluation_class, "
+        "engine_params_generator_class, batch, env, mesh_conf, "
+        "evaluator_results, evaluator_results_html, evaluator_results_json"
+    )
+
+    def _row(self, r) -> base.EvaluationInstance:
+        return base.EvaluationInstance(
+            id=r[0],
+            status=r[1],
+            start_time=_dt_from(r[2]),
+            end_time=_dt_from(r[3]),
+            evaluation_class=r[4],
+            engine_params_generator_class=r[5],
+            batch=r[6],
+            env=json.loads(r[7]),
+            mesh_conf=json.loads(r[8]),
+            evaluator_results=r[9],
+            evaluator_results_html=r[10],
+            evaluator_results_json=r[11],
+        )
+
+    def _vals(self, i: base.EvaluationInstance):
+        return (
+            i.id,
+            i.status,
+            _ts(i.start_time),
+            _ts(i.end_time),
+            i.evaluation_class,
+            i.engine_params_generator_class,
+            i.batch,
+            json.dumps(i.env),
+            json.dumps(i.mesh_conf),
+            i.evaluator_results,
+            i.evaluator_results_html,
+            i.evaluator_results_json,
+        )
+
+    def insert(self, instance: base.EvaluationInstance) -> str:
+        import secrets
+
+        instance.id = instance.id or secrets.token_hex(8)
+        with self.lock:
+            self.conn.execute(
+                f"INSERT OR REPLACE INTO evaluation_instances VALUES ({','.join('?' * 12)})",
+                self._vals(instance),
+            )
+            self.conn.commit()
+        return instance.id
+
+    def get(self, instance_id: str):
+        with self.lock:
+            r = self.conn.execute(
+                f"SELECT {self._COLS} FROM evaluation_instances WHERE id = ?",
+                (instance_id,),
+            ).fetchone()
+        return self._row(r) if r else None
+
+    def get_all(self):
+        with self.lock:
+            rows = self.conn.execute(
+                f"SELECT {self._COLS} FROM evaluation_instances"
+            ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def get_completed(self):
+        with self.lock:
+            rows = self.conn.execute(
+                f"SELECT {self._COLS} FROM evaluation_instances WHERE status = ? "
+                "ORDER BY start_time DESC",
+                (self.STATUS_COMPLETED,),
+            ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def update(self, instance: base.EvaluationInstance) -> bool:
+        with self.lock:
+            cur = self.conn.execute(
+                "UPDATE evaluation_instances SET status=?, start_time=?, end_time=?, "
+                "evaluation_class=?, engine_params_generator_class=?, batch=?, env=?, "
+                "mesh_conf=?, evaluator_results=?, evaluator_results_html=?, "
+                "evaluator_results_json=? WHERE id=?",
+                self._vals(instance)[1:] + (instance.id,),
+            )
+            self.conn.commit()
+        return cur.rowcount > 0
+
+    def delete(self, instance_id: str) -> bool:
+        with self.lock:
+            cur = self.conn.execute(
+                "DELETE FROM evaluation_instances WHERE id = ?", (instance_id,)
+            )
+            self.conn.commit()
+        return cur.rowcount > 0

@@ -1,0 +1,136 @@
+"""Event / DataMap / BiMap / aggregation tests.
+
+Parity model: data/src/test/.../storage/{DataMapSpec,BiMapSpec,
+LEventAggregatorSpec}.scala (SURVEY.md §4 tier 1).
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import BiMap, DataMap, Event, aggregate_properties
+from predictionio_tpu.data.batch import EventBatch
+
+UTC = dt.timezone.utc
+
+
+def ev(event, eid, props=None, t=0, target=None):
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=props or {},
+        event_time=dt.datetime(2026, 1, 1, tzinfo=UTC) + dt.timedelta(seconds=t),
+    )
+
+
+class TestEvent:
+    def test_roundtrip_json(self):
+        e = ev("rate", "u1", {"rating": 4.5}, t=5, target="i9")
+        e2 = Event.from_json(e.to_json())
+        assert e2.event == "rate"
+        assert e2.entity_id == "u1"
+        assert e2.target_entity_id == "i9"
+        assert e2.properties.get_double("rating") == 4.5
+        assert e2.event_time == e.event_time
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Event(event="", entity_type="user", entity_id="u1")
+        with pytest.raises(ValueError):
+            Event(event="$unknown", entity_type="user", entity_id="u1")
+        with pytest.raises(ValueError):  # $set must not have target
+            Event(
+                event="$set", entity_type="user", entity_id="u1",
+                target_entity_type="item", target_entity_id="i1",
+            )
+        with pytest.raises(ValueError):  # $unset needs properties
+            Event(event="$unset", entity_type="user", entity_id="u1")
+        with pytest.raises(ValueError):  # $delete must not have properties
+            Event(event="$delete", entity_type="user", entity_id="u1",
+                  properties={"a": 1})
+        with pytest.raises(ValueError):  # target type/id must come together
+            Event(event="buy", entity_type="user", entity_id="u1",
+                  target_entity_type="item")
+
+    def test_datamap_typed_getters(self):
+        d = DataMap({"a": 1, "b": "x", "c": [1.0, 2.0], "d": True})
+        assert d.get_int("a") == 1
+        assert d.get_string("b") == "x"
+        assert d.get_double_list("c") == [1.0, 2.0]
+        assert d.get_boolean("d") is True
+        with pytest.raises(KeyError):
+            d.require("zzz")
+        assert d.merge({"e": 5}).get_int("e") == 5
+        assert "a" not in d.remove(["a"])
+
+
+class TestBiMap:
+    def test_string_int(self):
+        m = BiMap.string_int(["a", "b", "a", "c"])
+        assert (m["a"], m["b"], m["c"]) == (0, 1, 2)
+        assert m.inverse[1] == "b"
+        assert len(m) == 3
+
+    def test_unique_values_enforced(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 1, "b": 1})
+
+    def test_index_array(self):
+        m = BiMap.string_int(["a", "b"])
+        np.testing.assert_array_equal(
+            m.to_index_array(["b", "zz", "a"]), np.array([1, -1, 0])
+        )
+
+
+class TestAggregation:
+    def test_set_unset_delete_fold(self):
+        events = [
+            ev("$set", "u1", {"a": 1, "b": 2}, t=0),
+            ev("$set", "u1", {"b": 3, "c": 4}, t=10),
+            ev("$unset", "u1", {"a": 1}, t=20),
+            ev("$set", "u2", {"x": 9}, t=0),
+            ev("$delete", "u3", t=5),
+            ev("$set", "u3", {"y": 1}, t=0),  # before the delete
+        ]
+        snap = aggregate_properties(events)
+        assert snap["u1"].to_dict() == {"b": 3, "c": 4}
+        assert snap["u1"].last_updated == ev("x", "u1", t=20).event_time
+        assert snap["u2"].to_dict() == {"x": 9}
+        assert "u3" not in snap  # deleted after set
+
+    def test_set_after_delete_restarts(self):
+        events = [
+            ev("$set", "u1", {"a": 1}, t=0),
+            ev("$delete", "u1", t=1),
+            ev("$set", "u1", {"b": 2}, t=2),
+        ]
+        snap = aggregate_properties(events)
+        assert snap["u1"].to_dict() == {"b": 2}
+        assert snap["u1"].first_updated == ev("x", "u1", t=2).event_time
+
+
+class TestEventBatch:
+    def test_columnar_roundtrip_and_interactions(self):
+        events = [
+            ev("rate", f"u{i % 3}", {"rating": float(i)}, t=i, target=f"i{i % 2}")
+            for i in range(6)
+        ]
+        b = EventBatch.from_events(events)
+        assert len(b) == 6
+        back = list(b)
+        assert back[0].event == "rate"
+        inter = b.interactions(rating_key="rating")
+        assert len(inter) == 6
+        assert inter.n_users == 3
+        assert inter.n_items == 2
+        # u0 rated i0 with 0.0 at t=0
+        assert inter.rating[0] == 0.0
+
+    def test_filter_events(self):
+        events = [ev("buy", "u1", t=0, target="i1"), ev("view", "u1", t=1, target="i1")]
+        b = EventBatch.from_events(events).filter_events(["buy"])
+        assert len(b) == 1 and b.event[0] == "buy"
