@@ -1,0 +1,29 @@
+from predictionio_tpu.core.controller import (
+    Algorithm,
+    AverageServing,
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    Preparator,
+    Serving,
+    ShardedAlgorithm,
+)
+from predictionio_tpu.core.engine import Engine, EngineFactory, EngineParams
+from predictionio_tpu.core.persistence import PersistentModel
+
+__all__ = [
+    "Algorithm",
+    "AverageServing",
+    "DataSource",
+    "Engine",
+    "EngineFactory",
+    "EngineParams",
+    "FirstServing",
+    "IdentityPreparator",
+    "Params",
+    "PersistentModel",
+    "Preparator",
+    "Serving",
+    "ShardedAlgorithm",
+]

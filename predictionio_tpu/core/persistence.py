@@ -1,0 +1,108 @@
+"""Model persistence: the three deploy-time modes, kept from the reference.
+
+Parity: ``controller/PersistentModel.scala`` + ``BaseAlgorithm.makePersistentModel``
+(``BaseAlgorithm.scala:111-115``) + manifest dispatch
+(``controller/Engine.scala:241-250``):
+
+1. **Auto-serialized** — the default: the (host-gathered) model pytree is
+   pickled into the MODELDATA repository, mirroring the reference's Kryo blob
+   (``CoreWorkflow.scala:76-81``; read back ``CreateServer.scala:202-206``).
+2. **PersistentModel** — the model class implements ``save``/``load`` itself
+   (e.g. orbax checkpoints of huge factor matrices); only a manifest naming
+   the class is stored in MODELDATA.
+3. **Retrain-on-deploy** — ``make_serializable_model`` returns :data:`RETRAIN`
+   and deploy re-runs training (the reference's Unit-model mode,
+   ``Engine.prepareDeploy``, ``Engine.scala:210-232``).
+"""
+
+from __future__ import annotations
+
+import abc
+import importlib
+import pickle
+from typing import Any, Optional
+
+
+class _RetrainSentinel:
+    def __repr__(self) -> str:
+        return "RETRAIN"
+
+
+RETRAIN = _RetrainSentinel()
+
+
+class PersistentModel(abc.ABC):
+    """Self-persisting model (parity: trait PersistentModel/Loader)."""
+
+    @abc.abstractmethod
+    def save(self, instance_id: str, params: Any) -> bool:
+        """Persist; return True to store a manifest (False ⇒ auto-pickle)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, instance_id: str, params: Any, ctx) -> "PersistentModel":
+        """Rebuild at deploy time."""
+
+
+def class_path(obj_or_cls) -> str:
+    cls = obj_or_cls if isinstance(obj_or_cls, type) else type(obj_or_cls)
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+def resolve_class(path: str):
+    """Import ``pkg.mod.Class`` (the Python replacement for JVM reflection)."""
+    module_name, _, cls_name = path.rpartition(".")
+    obj: Any = importlib.import_module(module_name)
+    for part in cls_name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def serialize_models(
+    instance_id: str, algorithms: list, models: list, algo_params: list
+) -> bytes:
+    """Build the MODELDATA blob (parity: Engine.makeSerializableModels:284).
+
+    Each slot is one of ``("pickle", blob)``, ``("manifest", class_path)`` or
+    ``("retrain", None)``.
+    """
+    slots = []
+    for algo, model, params in zip(algorithms, models, algo_params):
+        if isinstance(model, PersistentModel):
+            if model.save(instance_id, params):
+                slots.append(("manifest", class_path(model)))
+            else:
+                slots.append(("pickle", algo.make_serializable_model(model)))
+            continue
+        serializable = algo.make_serializable_model(model)
+        if serializable is RETRAIN or isinstance(serializable, _RetrainSentinel):
+            slots.append(("retrain", None))
+        else:
+            slots.append(("pickle", serializable))
+    return pickle.dumps(slots, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_models(
+    blob: bytes, instance_id: str, algorithms: list, algo_params: list, ctx
+) -> tuple[list, list[int]]:
+    """Rebuild models at deploy; returns (models, indices_needing_retrain).
+
+    Parity: ``Engine.prepareDeploy`` (``Engine.scala:198-267``).
+    """
+    slots = pickle.loads(blob)
+    models: list = []
+    retrain_idx: list[int] = []
+    for i, ((kind, payload), algo, params) in enumerate(
+        zip(slots, algorithms, algo_params)
+    ):
+        if kind == "pickle":
+            models.append(algo.load_serializable_model(ctx, payload))
+        elif kind == "manifest":
+            cls = resolve_class(payload)
+            models.append(cls.load(instance_id, params, ctx))
+        elif kind == "retrain":
+            models.append(None)
+            retrain_idx.append(i)
+        else:
+            raise ValueError(f"unknown model slot kind {kind!r}")
+    return models, retrain_idx

@@ -1,0 +1,183 @@
+"""Workflow executors: train and deploy-preparation entry points.
+
+Parity: ``workflow/CoreWorkflow.scala:45-164`` (runTrain: context → train →
+serialize models → EngineInstance COMPLETED) and ``Engine.prepareDeploy``
+(``Engine.scala:198-267``).  Key structural difference from the reference:
+there is NO spark-submit process hop (``tools/Runner.scala:185-334``) — the
+mesh lives in-process, so ``run_train`` is a plain function call from the CLI
+(SURVEY.md §7 "spark-submit process hop → in-process train()").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import logging
+from typing import Optional
+
+from predictionio_tpu.core import persistence
+from predictionio_tpu.core.engine import Engine, EngineParams
+from predictionio_tpu.data.storage.base import EngineInstance, Model
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.parallel.mesh import MeshContext
+
+logger = logging.getLogger(__name__)
+
+UTC = _dt.timezone.utc
+
+
+@dataclasses.dataclass
+class WorkflowParams:
+    """Knobs of a workflow run (parity: workflow/WorkflowParams.scala)."""
+
+    batch: str = ""
+    verbose: int = 0
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+
+
+def resolve_engine(engine_factory: str) -> Engine:
+    """Dotted-path → Engine (parity: CreateWorkflow reflective factory load,
+    ``CreateWorkflow.scala:196-204``)."""
+    obj = persistence.resolve_class(engine_factory)
+    if isinstance(obj, Engine):
+        return obj
+    if isinstance(obj, type):
+        candidate = obj.apply() if hasattr(obj, "apply") else obj()
+    elif callable(obj):
+        candidate = obj()
+    else:
+        candidate = obj
+    if not isinstance(candidate, Engine):
+        raise TypeError(
+            f"{engine_factory} resolved to {type(candidate).__name__}, not an Engine"
+        )
+    return candidate
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    engine_factory: str,
+    storage: Optional[Storage] = None,
+    ctx: Optional[MeshContext] = None,
+    workflow_params: Optional[WorkflowParams] = None,
+    engine_id: str = "default",
+    engine_version: str = "default",
+    engine_variant: str = "default",
+    env: Optional[dict] = None,
+) -> str:
+    """Train and persist; returns the COMPLETED EngineInstance id.
+
+    Parity with CoreWorkflow.runTrain (CoreWorkflow.scala:45-101):
+    insert INIT instance → train → serialize models into MODELDATA →
+    update status COMPLETED.
+    """
+    storage = storage or Storage.instance()
+    ctx = ctx or MeshContext.create()
+    wp = workflow_params or WorkflowParams()
+
+    instances = storage.get_meta_data_engine_instances()
+    now = _dt.datetime.now(tz=UTC)
+    instance = EngineInstance(
+        id="",
+        status=instances.STATUS_INIT,
+        start_time=now,
+        end_time=now,
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        engine_factory=engine_factory,
+        batch=wp.batch,
+        env=dict(env or {}),
+        mesh_conf=dict(ctx.conf),
+        **engine_params.to_json_strings(),
+    )
+    instance_id = instances.insert(instance)
+    logger.info("engine instance %s: training started", instance_id)
+
+    instance.status = instances.STATUS_TRAINING
+    instances.update(instance)
+
+    algorithms = engine.make_algorithms(engine_params)
+    models = engine.train(
+        ctx,
+        engine_params,
+        skip_sanity_check=wp.skip_sanity_check,
+        stop_after_read=wp.stop_after_read,
+        stop_after_prepare=wp.stop_after_prepare,
+        algorithms=algorithms,
+    )
+
+    algo_params = [p for _, p in engine_params.algorithm_params_list]
+    blob = persistence.serialize_models(instance_id, algorithms, models, algo_params)
+    storage.get_model_data_models().insert(Model(id=instance_id, models=blob))
+
+    instance.status = instances.STATUS_COMPLETED
+    instance.end_time = _dt.datetime.now(tz=UTC)
+    instances.update(instance)
+    logger.info("engine instance %s: training completed", instance_id)
+    return instance_id
+
+
+def prepare_deploy(
+    engine: Engine,
+    instance: EngineInstance,
+    storage: Optional[Storage] = None,
+    ctx: Optional[MeshContext] = None,
+):
+    """Load a COMPLETED instance's models for serving.
+
+    Returns (engine_params, algorithms, serving, models).
+    Parity: CreateServer.createPredictionServerWithEngine + Engine.prepareDeploy
+    (CreateServer.scala:193-206, Engine.scala:198-267): rebuild EngineParams
+    from the instance row, invert the model blob, retrain Unit-mode slots.
+    """
+    storage = storage or Storage.instance()
+    ctx = ctx or MeshContext.create(conf=instance.mesh_conf)
+
+    engine_params = engine.params_from_instance_strings(
+        {
+            "data_source_params": instance.data_source_params,
+            "preparator_params": instance.preparator_params,
+            "algorithms_params": instance.algorithms_params,
+            "serving_params": instance.serving_params,
+        }
+    )
+    algorithms = engine.make_algorithms(engine_params)
+    algo_params = [p for _, p in engine_params.algorithm_params_list]
+
+    model_row = storage.get_model_data_models().get(instance.id)
+    if model_row is None:
+        raise RuntimeError(f"no model blob for engine instance {instance.id}")
+    models, retrain_idx = persistence.deserialize_models(
+        model_row.models, instance.id, algorithms, algo_params, ctx
+    )
+    if retrain_idx:
+        # Unit-model mode: retrain ONLY those slots (Engine.scala:210-232);
+        # read+prepare once, skip algorithms whose models deserialized.
+        logger.info("retrain-on-deploy for algorithm slots %s", retrain_idx)
+        pd = engine.prepare_data(ctx, engine_params, skip_sanity_check=True)
+        for i in retrain_idx:
+            models[i] = algorithms[i].train(ctx, pd)
+    serving = engine.make_serving(engine_params)
+    return engine_params, algorithms, serving, models
+
+
+def get_latest_completed_instance(
+    storage: Storage,
+    engine_id: str = "default",
+    engine_version: str = "default",
+    engine_variant: str = "default",
+) -> EngineInstance:
+    """Deploy-time lookup (parity: commands/Engine.scala:234-241)."""
+    instances = storage.get_meta_data_engine_instances()
+    inst = instances.get_latest_completed(engine_id, engine_version, engine_variant)
+    if inst is None:
+        raise RuntimeError(
+            f"No completed engine instance for {engine_id}/{engine_version}/"
+            f"{engine_variant}. Run train first."
+        )
+    return inst

@@ -182,8 +182,9 @@ class EventValidation:
                 f"{e.event} is not a supported reserved event name "
                 f"(supported: {sorted(cls.SPECIAL_EVENTS)})."
             )
-        if e.event in (cls.SET, cls.UNSET) and e.target_entity_id is not None:
-            raise ValueError(f"{e.event} must not have targetEntityId.")
+        # no reserved event may carry a target (parity: Event.scala:129-131)
+        if e.event in cls.SPECIAL_EVENTS and e.target_entity_id is not None:
+            raise ValueError(f"{e.event} must not have targetEntity.")
         if e.event == cls.UNSET and e.properties.is_empty:
             raise ValueError("$unset must have non-empty properties.")
         if e.event == cls.DELETE and not e.properties.is_empty:

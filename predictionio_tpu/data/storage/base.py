@@ -28,7 +28,7 @@ import secrets
 from dataclasses import dataclass, field
 from typing import Iterable, Optional, Sequence
 
-from predictionio_tpu.data.aggregator import PropertyAggregate
+from predictionio_tpu.data.aggregator import aggregate_properties
 from predictionio_tpu.data.batch import EventBatch
 from predictionio_tpu.data.event import Event, EventValidation, PropertyMap
 
@@ -202,22 +202,21 @@ class LEvents(abc.ABC):
             entity_type=entity_type,
             event_names=sorted(EventValidation.SPECIAL_EVENTS),
         )
-        per_entity: dict[str, list[Event]] = {}
-        for e in events:
-            per_entity.setdefault(e.entity_id, []).append(e)
-        out: dict[str, PropertyMap] = {}
-        for entity_id, evs in per_entity.items():
-            evs.sort(key=lambda e: (e.event_time, e.creation_time))
-            agg = PropertyAggregate()
-            for e in evs:
-                agg = agg.update(e)
-            pm = agg.to_property_map()
-            if pm is None:
-                continue
-            if required and not all(k in pm for k in required):
-                continue
-            out[entity_id] = pm
-        return out
+        return _fold_properties(events, required)
+
+
+def _fold_properties(
+    events: Iterable[Event], required: Optional[Sequence[str]]
+) -> dict[str, PropertyMap]:
+    """Shared DAO-side fold: aggregate + optional required-keys filter."""
+    snapshots = aggregate_properties(events)
+    if not required:
+        return snapshots
+    return {
+        eid: pm
+        for eid, pm in snapshots.items()
+        if all(k in pm for k in required)
+    }
 
 
 class PEvents(abc.ABC):
@@ -259,22 +258,7 @@ class PEvents(abc.ABC):
             entity_type=entity_type,
             event_names=sorted(EventValidation.SPECIAL_EVENTS),
         )
-        per_entity: dict[str, list[Event]] = {}
-        for e in batch:
-            per_entity.setdefault(e.entity_id, []).append(e)
-        out: dict[str, PropertyMap] = {}
-        for entity_id, evs in per_entity.items():
-            evs.sort(key=lambda ev: (ev.event_time, ev.creation_time))
-            agg = PropertyAggregate()
-            for e in evs:
-                agg = agg.update(e)
-            pm = agg.to_property_map()
-            if pm is None:
-                continue
-            if required and not all(k in pm for k in required):
-                continue
-            out[entity_id] = pm
-        return out
+        return _fold_properties(batch, required)
 
     @abc.abstractmethod
     def write(
@@ -384,10 +368,7 @@ class EngineInstances(abc.ABC):
         self, engine_id: str, engine_version: str, engine_variant: str
     ) -> Optional[EngineInstance]:
         """Parity: EngineInstances.getLatestCompleted — newest COMPLETED run."""
-        candidates = [
-            i
-            for i in self.get_completed(engine_id, engine_version, engine_variant)
-        ]
+        candidates = self.get_completed(engine_id, engine_version, engine_variant)
         return candidates[0] if candidates else None
 
     @abc.abstractmethod

@@ -8,6 +8,7 @@ driver can reuse this contract.
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Optional
 
@@ -26,6 +27,10 @@ class LocalFSModels(base.Models):
 
     def _path(self, model_id: str) -> str:
         safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in model_id)
+        if safe != model_id:
+            # keep sanitized ids collision-free ("a/b" vs "a_b")
+            digest = hashlib.sha1(model_id.encode()).hexdigest()[:12]
+            safe = f"{safe}.{digest}"
         return os.path.join(self._dir, safe)
 
     def insert(self, model: base.Model) -> None:

@@ -52,6 +52,13 @@ def reset_store(name: str = "default") -> None:
         _STORES.pop(name, None)
 
 
+def _aware(d: Optional[_dt.datetime]) -> Optional[_dt.datetime]:
+    """Naive filter datetimes are interpreted as UTC (matches sqlite _ts)."""
+    if d is not None and d.tzinfo is None:
+        return d.replace(tzinfo=_dt.timezone.utc)
+    return d
+
+
 def match_event(
     e: Event,
     start_time=None,
@@ -68,6 +75,7 @@ def match_event(
     time range is [start, until); ``target_entity_type="None"`` (string)
     matches events WITHOUT a target.
     """
+    start_time, until_time = _aware(start_time), _aware(until_time)
     if start_time is not None and e.event_time < start_time:
         return False
     if until_time is not None and e.event_time >= until_time:
@@ -89,18 +97,23 @@ def match_event(
     return True
 
 
+def _key(app_id: int, channel_id: Optional[int]) -> tuple[int, int]:
+    """Default channel (None) and channel 0 alias, matching the sqlite driver."""
+    return (app_id, 0 if channel_id is None else channel_id)
+
+
 class MemoryLEvents(base.LEvents):
     def __init__(self, source_name: str = "default", **_):
         self._s = get_store(source_name)
 
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self._s.lock:
-            self._s.events.setdefault((app_id, channel_id), {})
+            self._s.events.setdefault(_key(app_id, channel_id), {})
         return True
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self._s.lock:
-            self._s.events.pop((app_id, channel_id), None)
+            self._s.events.pop(_key(app_id, channel_id), None)
         return True
 
     def close(self) -> None:
@@ -109,17 +122,17 @@ class MemoryLEvents(base.LEvents):
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         eid = event.event_id or new_event_id()
         with self._s.lock:
-            ns = self._s.events.setdefault((app_id, channel_id), {})
+            ns = self._s.events.setdefault(_key(app_id, channel_id), {})
             ns[eid] = event.with_id(eid)
         return eid
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None):
         with self._s.lock:
-            return self._s.events.get((app_id, channel_id), {}).get(event_id)
+            return self._s.events.get(_key(app_id, channel_id), {}).get(event_id)
 
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self._s.lock:
-            ns = self._s.events.get((app_id, channel_id), {})
+            ns = self._s.events.get(_key(app_id, channel_id), {})
             return ns.pop(event_id, None) is not None
 
     def find(
@@ -137,7 +150,7 @@ class MemoryLEvents(base.LEvents):
         reversed: bool = False,
     ) -> Iterable[Event]:
         with self._s.lock:
-            evs = list(self._s.events.get((app_id, channel_id), {}).values())
+            evs = list(self._s.events.get(_key(app_id, channel_id), {}).values())
         evs = [
             e
             for e in evs

@@ -79,7 +79,37 @@ def get_db(path: str) -> _Db:
     with _CONNS_LOCK:
         if key not in _CONNS:
             _CONNS[key] = _Db(path)
+            _CONNS[key].key = key
         return _CONNS[key]
+
+
+def close_db(path_or_db) -> None:
+    """Close and evict one cached connection (all DAOs sharing it go stale)."""
+    if isinstance(path_or_db, _Db):
+        key, want = path_or_db.key, path_or_db
+    else:
+        key = (
+            os.path.abspath(path_or_db) if path_or_db != ":memory:" else ":memory:"
+        )
+        want = None
+    with _CONNS_LOCK:
+        db = _CONNS.get(key)
+        if db is None or (want is not None and db is not want):
+            db = want  # stale handle: close it, leave the live cache alone
+        else:
+            _CONNS.pop(key)
+    if db is not None:
+        with db.lock:
+            db.conn.close()
+
+
+def close_all_dbs() -> None:
+    with _CONNS_LOCK:
+        dbs = list(_CONNS.values())
+        _CONNS.clear()
+    for db in dbs:
+        with db.lock:
+            db.conn.close()
 
 
 def _default_path(source_name: str) -> str:
@@ -188,6 +218,8 @@ class SqliteLEvents(_SqliteDAO, base.LEvents):
         return True
 
     def close(self) -> None:
+        # Connection lifecycle is owned by the module-level cache: other DAOs
+        # share this _Db, so per-DAO close is a no-op. Use close_db/close_all_dbs.
         pass
 
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:

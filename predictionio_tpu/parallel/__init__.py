@@ -1,0 +1,7 @@
+from predictionio_tpu.parallel.mesh import (
+    MeshContext,
+    make_mesh,
+    pad_to_multiple,
+)
+
+__all__ = ["MeshContext", "make_mesh", "pad_to_multiple"]

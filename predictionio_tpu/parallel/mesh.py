@@ -1,0 +1,142 @@
+"""Device mesh + sharding: the compute fabric replacing SparkContext.
+
+Where every reference workflow entry point builds a ``SparkContext``
+(``core/.../workflow/WorkflowContext.scala``) and distributes work as RDD
+partitions over executors, the TPU-native equivalent is a
+:class:`jax.sharding.Mesh` over the chips of a slice (or several slices), with
+XLA collectives over ICI/DCN doing what Spark shuffle did (SURVEY.md §2.7).
+
+:class:`MeshContext` is the ``sc`` of this framework: it is handed to every
+DataSource/Preparator/Algorithm and carries the mesh plus placement helpers.
+Axis conventions:
+
+* ``data``  — batch/entity dimension (users, queries, events): data parallelism
+* ``model`` — feature/factor dimension: tensor-style model parallelism
+
+Multi-host note: on a pod slice each host runs this same program
+(``jax.distributed``-initialized); ``make_mesh`` uses all global devices so
+shardings lay collectives onto ICI first (mesh axes ordered devices-major).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import os
+from typing import Any, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    """Smallest multiple of m that is >= max(n, 1) — static-shape padding."""
+    return max(1, math.ceil(max(n, 1) / m)) * m
+
+
+def make_mesh(
+    axes: Optional[Mapping[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh. Default: 1-D ``data`` axis over all visible devices.
+
+    ``axes={"data": -1, "model": 2}`` lets one axis be inferred (-1) from the
+    device count, mirroring how Spark infers partition counts.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if axes is None:
+        axes = {DATA_AXIS: n}
+    axes = dict(axes)
+    known = 1
+    infer_key = None
+    for k, v in axes.items():
+        if v == -1:
+            if infer_key is not None:
+                raise ValueError("only one mesh axis may be -1")
+            infer_key = k
+        else:
+            known *= v
+    if infer_key is not None:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        axes[infer_key] = n // known
+    total = math.prod(axes.values())
+    if total != n:
+        raise ValueError(f"mesh axes {axes} need {total} devices, have {n}")
+    dev_array = np.array(devs).reshape(tuple(axes.values()))
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+@dataclasses.dataclass
+class MeshContext:
+    """The compute context handed through the DASE pipeline (replaces ``sc``).
+
+    Parity role: the ``sc: SparkContext`` parameter threaded through
+    ``BaseDataSource.readTrainingBase`` / ``BaseAlgorithm.trainBase``
+    (``core/.../core/BaseAlgorithm.scala:69``); here it carries the device
+    mesh and placement helpers instead of an RDD factory.
+    """
+
+    mesh: Mesh
+    conf: dict = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def create(
+        conf: Optional[dict] = None,
+        axes: Optional[Mapping[str, int]] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> "MeshContext":
+        conf = dict(conf or {})
+        if axes is None and "mesh_axes" in conf:
+            axes = {k: int(v) for k, v in conf["mesh_axes"].items()}
+        return MeshContext(mesh=make_mesh(axes=axes, devices=devices), conf=conf)
+
+    # -- placement helpers -------------------------------------------------
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.size
+
+    def axis_size(self, axis: str) -> int:
+        return self.mesh.shape.get(axis, 1)
+
+    def sharding(self, *spec: Any) -> NamedSharding:
+        """NamedSharding from a PartitionSpec-style tuple."""
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_rows(self, x, axis: str = DATA_AXIS):
+        """Place array with dim 0 sharded over ``axis`` (pads to divisible)."""
+        import jax.numpy as jnp
+
+        size = self.axis_size(axis)
+        n = x.shape[0]
+        padded = pad_to_multiple(n, size)
+        if padded != n:
+            pad_width = [(0, padded - n)] + [(0, 0)] * (x.ndim - 1)
+            x = np.pad(np.asarray(x), pad_width)
+        spec = (axis,) + (None,) * (x.ndim - 1)
+        return jax.device_put(jnp.asarray(x), self.sharding(*spec))
+
+    def replicate(self, x):
+        import jax.numpy as jnp
+
+        return jax.device_put(jnp.asarray(x), self.replicated())
+
+    def to_host(self, tree):
+        """Device pytree → host numpy pytree (for persistence)."""
+        return jax.tree.map(lambda a: np.asarray(jax.device_get(a)), tree)
+
+
+def default_context(conf: Optional[dict] = None) -> MeshContext:
+    """The workflow-level factory (parity: WorkflowContext SparkContext)."""
+    return MeshContext.create(conf=conf)

@@ -7,12 +7,18 @@ This is the TPU-build analogue of the reference's Spark ``local[N]`` masters
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # axon (real TPU) may be preset; tests use CPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Something in this image re-appends the axon platform to jax_platforms even
+# with JAX_PLATFORMS=cpu in env, so pin it at the config level too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,180 @@
+"""Deterministic mini-DASE fixtures whose outputs encode their inputs.
+
+Parity model: core/src/test/.../controller/SampleEngine.scala:29-400 — tiny
+components whose outputs carry their ids so tests assert the exact wiring of
+the train/eval plumbing.
+"""
+
+import dataclasses
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    Params,
+    Preparator,
+    Serving,
+)
+from predictionio_tpu.core.controller import SanityCheck
+from predictionio_tpu.core.persistence import RETRAIN, PersistentModel
+
+
+@dataclasses.dataclass
+class DSParams(Params):
+    id: int = 0
+    error: bool = False
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    id: int
+    error: bool = False
+
+    def sanity_check(self):
+        if self.error:
+            raise ValueError(f"TrainingData {self.id} is bad")
+
+
+@dataclasses.dataclass
+class ProcessedData(SanityCheck):
+    id: int
+    td: TrainingData
+
+    def sanity_check(self):
+        pass
+
+
+@dataclasses.dataclass
+class Query:
+    q: int
+
+
+@dataclasses.dataclass
+class Prediction:
+    q: int
+    models: tuple = ()
+    supplemented: bool = False
+
+
+@dataclasses.dataclass
+class Actual:
+    a: int
+
+
+class SampleDataSource(DataSource):
+    params_cls = DSParams
+
+    def read_training(self, ctx):
+        return TrainingData(self.params.id, self.params.error)
+
+    def read_eval(self, ctx):
+        td = TrainingData(self.params.id)
+        return [
+            (td, [(Query(q), Actual(q * 10)) for q in range(3)]),
+            (td, [(Query(q), Actual(q * 10)) for q in range(2)]),
+        ]
+
+
+@dataclasses.dataclass
+class PrepParams(Params):
+    id: int = 0
+
+
+class SamplePreparator(Preparator):
+    params_cls = PrepParams
+
+    def prepare(self, ctx, td):
+        return ProcessedData(self.params.id, td)
+
+
+@dataclasses.dataclass
+class AlgoParams(Params):
+    id: int = 0
+
+
+@dataclasses.dataclass
+class SampleModel:
+    algo_id: int
+    pd_id: int
+
+
+class SampleAlgorithm(Algorithm):
+    params_cls = AlgoParams
+
+    def train(self, ctx, pd):
+        return SampleModel(self.params.id, pd.id)
+
+    def predict(self, model, query):
+        return Prediction(
+            q=query.q,
+            models=((model.algo_id, model.pd_id),),
+            supplemented=getattr(query, "_supp", False),
+        )
+
+
+class RetrainAlgorithm(SampleAlgorithm):
+    """Opts into retrain-on-deploy (Unit-model mode)."""
+
+    def make_serializable_model(self, model):
+        return RETRAIN
+
+
+@dataclasses.dataclass
+class SamplePersistentModel(PersistentModel):
+    algo_id: int
+    pd_id: int
+
+    _saved: dict = dataclasses.field(default_factory=dict, repr=False)
+
+    SAVED: dict = None  # class-level store set by tests
+
+    def save(self, instance_id, params):
+        type(self).SAVED[instance_id] = (self.algo_id, self.pd_id)
+        return True
+
+    @classmethod
+    def load(cls, instance_id, params, ctx):
+        algo_id, pd_id = cls.SAVED[instance_id]
+        return cls(algo_id, pd_id)
+
+
+class PersistentAlgorithm(SampleAlgorithm):
+    def train(self, ctx, pd):
+        return SamplePersistentModel(self.params.id, pd.id)
+
+    def predict(self, model, query):
+        return Prediction(q=query.q, models=((model.algo_id, model.pd_id),))
+
+
+class SampleServing(Serving):
+    def supplement(self, query):
+        query._supp = True
+        return query
+
+    def serve(self, query, predictions):
+        models = tuple(m for p in predictions for m in p.models)
+        return Prediction(q=query.q, models=models, supplemented=True)
+
+
+def make_engine(algos=None):
+    return Engine(
+        data_source_cls=SampleDataSource,
+        preparator_cls=SamplePreparator,
+        algorithm_cls_map=algos
+        or {"sample": SampleAlgorithm, "retrain": RetrainAlgorithm,
+            "persistent": PersistentAlgorithm},
+        serving_cls=SampleServing,
+        query_cls=Query,
+    )
+
+
+class SampleEngineFactory(EngineFactory):
+    @classmethod
+    def apply(cls):
+        return make_engine()
+
+
+def sample_engine() -> Engine:
+    """Module-level factory resolvable by dotted path."""
+    return make_engine()

@@ -1,0 +1,189 @@
+"""DASE wiring + workflow tests against the deterministic SampleEngine.
+
+Parity model: core/src/test/.../controller/{EngineTest,EngineWorkflowTest}.scala
+(SURVEY.md §4 tier 1).
+"""
+
+import json
+
+import pytest
+
+from predictionio_tpu.core.engine import EngineParams, params_from_json
+from predictionio_tpu.core.workflow import (
+    WorkflowParams,
+    get_latest_completed_instance,
+    prepare_deploy,
+    resolve_engine,
+    run_train,
+)
+from predictionio_tpu.core.engine import (
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+)
+from predictionio_tpu.parallel.mesh import MeshContext
+
+from sample_engine import (
+    AlgoParams,
+    DSParams,
+    PrepParams,
+    Query,
+    SamplePersistentModel,
+    make_engine,
+)
+
+
+@pytest.fixture()
+def ctx():
+    return MeshContext.create()
+
+
+def engine_params(algos=(("sample", AlgoParams(7)),)):
+    return EngineParams(
+        data_source_params=DSParams(id=3),
+        preparator_params=PrepParams(id=5),
+        algorithm_params_list=list(algos),
+        serving_params=None,
+    )
+
+
+class TestEngineTrain:
+    def test_train_wiring(self, ctx):
+        engine = make_engine()
+        models = engine.train(ctx, engine_params())
+        assert len(models) == 1
+        # model encodes (algo id, prepared-data id): proof of DS→Prep→Algo wiring
+        assert (models[0].algo_id, models[0].pd_id) == (7, 5)
+
+    def test_multi_algo(self, ctx):
+        engine = make_engine()
+        models = engine.train(
+            ctx, engine_params([("sample", AlgoParams(1)), ("sample", AlgoParams(2))])
+        )
+        assert [m.algo_id for m in models] == [1, 2]
+
+    def test_sanity_check_raises(self, ctx):
+        engine = make_engine()
+        ep = engine_params()
+        ep.data_source_params = DSParams(id=3, error=True)
+        with pytest.raises(ValueError, match="TrainingData 3 is bad"):
+            engine.train(ctx, ep)
+        engine.train(ctx, ep, skip_sanity_check=True)  # bypass works
+
+    def test_stop_after_interrupts(self, ctx):
+        engine = make_engine()
+        with pytest.raises(StopAfterReadInterruption):
+            engine.train(ctx, engine_params(), stop_after_read=True)
+        with pytest.raises(StopAfterPrepareInterruption):
+            engine.train(ctx, engine_params(), stop_after_prepare=True)
+
+    def test_eval_join(self, ctx):
+        engine = make_engine()
+        results = engine.eval(ctx, engine_params([("sample", AlgoParams(1)),
+                                                  ("sample", AlgoParams(2))]))
+        assert len(results) == 2  # two folds from read_eval
+        _, triples = results[0]
+        assert len(triples) == 3
+        q, p, a = triples[1]
+        assert q.q == 1 and a.a == 10
+        # serving joined predictions from both algorithms, both supplemented
+        assert p.models == ((1, 5), (2, 5))
+        assert p.supplemented
+
+
+class TestEngineJsonBinding:
+    def test_variant_parsing(self):
+        engine = make_engine()
+        variant = {
+            "id": "default",
+            "engineFactory": "sample_engine.sample_engine",
+            "datasource": {"params": {"id": 11}},
+            "preparator": {"params": {"id": 12}},
+            "algorithms": [{"name": "sample", "params": {"id": 13}}],
+        }
+        ep = engine.params_from_variant(variant)
+        assert ep.data_source_params.id == 11
+        assert ep.preparator_params.id == 12
+        assert ep.algorithm_params_list == [("sample", AlgoParams(13))]
+
+    def test_unknown_param_rejected(self):
+        engine = make_engine()
+        with pytest.raises(ValueError, match="unknown parameter"):
+            engine.params_from_variant({"datasource": {"params": {"nope": 1}}})
+
+    def test_unknown_algorithm_rejected(self):
+        engine = make_engine()
+        with pytest.raises(ValueError, match="not registered"):
+            engine.params_from_variant({"algorithms": [{"name": "zzz"}]})
+
+    def test_params_json_roundtrip(self):
+        ep = engine_params()
+        strings = ep.to_json_strings()
+        engine = make_engine()
+        ep2 = engine.params_from_instance_strings(strings)
+        assert ep2.data_source_params == ep.data_source_params
+        assert ep2.algorithm_params_list == ep.algorithm_params_list
+        assert json.loads(strings["algorithms_params"])[0]["name"] == "sample"
+
+
+class TestRunTrainAndDeploy:
+    def test_full_cycle_auto_persistence(self, storage, ctx):
+        engine = make_engine()
+        iid = run_train(
+            engine,
+            engine_params(),
+            engine_factory="sample_engine.sample_engine",
+            storage=storage,
+            ctx=ctx,
+        )
+        inst = get_latest_completed_instance(storage)
+        assert inst.id == iid
+        assert inst.status == "COMPLETED"
+        ep, algorithms, serving, models = prepare_deploy(
+            engine, inst, storage=storage, ctx=ctx
+        )
+        assert (models[0].algo_id, models[0].pd_id) == (7, 5)
+        # serve a query end-to-end through deployed components
+        q = serving.supplement(Query(q=42))
+        preds = [a.predict(m, q) for a, m in zip(algorithms, models)]
+        out = serving.serve(q, preds)
+        assert out.q == 42 and out.models == ((7, 5),)
+
+    def test_retrain_on_deploy(self, storage, ctx):
+        engine = make_engine()
+        iid = run_train(
+            engine,
+            engine_params([("retrain", AlgoParams(9))]),
+            engine_factory="sample_engine.sample_engine",
+            storage=storage,
+            ctx=ctx,
+        )
+        inst = storage.get_meta_data_engine_instances().get(iid)
+        _, _, _, models = prepare_deploy(engine, inst, storage=storage, ctx=ctx)
+        # model was NOT in the blob; it was retrained at deploy time
+        assert (models[0].algo_id, models[0].pd_id) == (9, 5)
+
+    def test_persistent_model_manifest(self, storage, ctx):
+        SamplePersistentModel.SAVED = {}
+        engine = make_engine()
+        iid = run_train(
+            engine,
+            engine_params([("persistent", AlgoParams(4))]),
+            engine_factory="sample_engine.sample_engine",
+            storage=storage,
+            ctx=ctx,
+        )
+        assert SamplePersistentModel.SAVED[iid] == (4, 5)
+        inst = storage.get_meta_data_engine_instances().get(iid)
+        _, _, _, models = prepare_deploy(engine, inst, storage=storage, ctx=ctx)
+        assert isinstance(models[0], SamplePersistentModel)
+        assert models[0].algo_id == 4
+
+    def test_deploy_requires_completed(self, storage):
+        with pytest.raises(RuntimeError, match="No completed engine instance"):
+            get_latest_completed_instance(storage)
+
+    def test_resolve_engine_by_dotted_path(self):
+        engine = resolve_engine("sample_engine.sample_engine")
+        assert "sample" in engine.algorithm_cls_map
+        engine2 = resolve_engine("sample_engine.SampleEngineFactory")
+        assert "sample" in engine2.algorithm_cls_map

@@ -47,6 +47,10 @@ def driver_env(request, tmp_path):
         from predictionio_tpu.data.storage import memory
 
         memory.reset_store(name)
+    else:
+        from predictionio_tpu.data.storage import sqlite
+
+        sqlite.close_db(str(tmp_path / "pio.sqlite"))
 
 
 @pytest.fixture()
