@@ -1,0 +1,75 @@
+"""Benchmark: ALS training throughput (events/sec/chip) on the local device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md); vs_baseline is measured
+against the driver-set north star: MovieLens-25M × 20 iterations on v5e-16
+in 60 s ⇒ ~520,833 events/sec/chip.  vs_baseline = value / north_star.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+NORTH_STAR_EVENTS_PER_SEC_PER_CHIP = 25_000_000 * 20 / (60 * 16)
+
+
+def main() -> None:
+    import jax
+
+    from predictionio_tpu.data.batch import Interactions
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models import als
+    from predictionio_tpu.parallel.mesh import MeshContext
+
+    # MovieLens-25M scale (the reference's largest workload config) with the
+    # recommendation template's default rank/iterations (BASELINE.md)
+    n_users = int(os.environ.get("BENCH_USERS", 162_000))
+    n_items = int(os.environ.get("BENCH_ITEMS", 59_000))
+    n_ratings = int(os.environ.get("BENCH_RATINGS", 25_000_000))
+    rank = int(os.environ.get("BENCH_RANK", 10))
+    iterations = int(os.environ.get("BENCH_ITERS", 20))
+
+    rng = np.random.default_rng(0)
+    inter = Interactions(
+        user=rng.integers(0, n_users, n_ratings).astype(np.int32),
+        item=rng.integers(0, n_items, n_ratings).astype(np.int32),
+        rating=rng.uniform(1.0, 5.0, n_ratings).astype(np.float32),
+        t=np.zeros(n_ratings),
+        user_map=None,
+        item_map=None,
+    )
+    inter.user_map = BiMap({f"u{i}": i for i in range(n_users)})
+    inter.item_map = BiMap({f"i{i}": i for i in range(n_items)})
+
+    ctx = MeshContext.create()
+    n_chips = ctx.n_devices
+
+    # warm-up: compile the step (first TPU compile is slow, cached after)
+    als.train_als(ctx, inter, als.ALSConfig(rank=rank, iterations=1))
+
+    t0 = time.perf_counter()
+    als.train_als(ctx, inter, als.ALSConfig(rank=rank, iterations=iterations))
+    dt = time.perf_counter() - t0
+
+    events_per_sec_per_chip = n_ratings * iterations / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "als_train_events_per_sec_per_chip",
+                "value": round(events_per_sec_per_chip, 1),
+                "unit": "events/s/chip",
+                "vs_baseline": round(
+                    events_per_sec_per_chip / NORTH_STAR_EVENTS_PER_SEC_PER_CHIP, 4
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,11 @@ def params_from_json(params_cls: Optional[Type[Params]], obj: Any) -> Params:
         obj = {}
     if not dataclasses.is_dataclass(params_cls):
         raise TypeError(f"{params_cls} must be a dataclass Params")
+    # json_aliases maps JSON keys that aren't valid Python identifiers
+    # (e.g. the reference's "lambda") onto dataclass field names
+    aliases = getattr(params_cls, "json_aliases", {})
+    if aliases:
+        obj = {aliases.get(k, k): v for k, v in obj.items()}
     names = {f.name for f in dataclasses.fields(params_cls)}
     unknown = set(obj) - names
     if unknown:

@@ -101,19 +101,28 @@ def run_train(
     instance.status = instances.STATUS_TRAINING
     instances.update(instance)
 
-    algorithms = engine.make_algorithms(engine_params)
-    models = engine.train(
-        ctx,
-        engine_params,
-        skip_sanity_check=wp.skip_sanity_check,
-        stop_after_read=wp.stop_after_read,
-        stop_after_prepare=wp.stop_after_prepare,
-        algorithms=algorithms,
-    )
+    try:
+        algorithms = engine.make_algorithms(engine_params)
+        models = engine.train(
+            ctx,
+            engine_params,
+            skip_sanity_check=wp.skip_sanity_check,
+            stop_after_read=wp.stop_after_read,
+            stop_after_prepare=wp.stop_after_prepare,
+            algorithms=algorithms,
+        )
 
-    algo_params = [p for _, p in engine_params.algorithm_params_list]
-    blob = persistence.serialize_models(instance_id, algorithms, models, algo_params)
-    storage.get_model_data_models().insert(Model(id=instance_id, models=blob))
+        algo_params = [p for _, p in engine_params.algorithm_params_list]
+        blob = persistence.serialize_models(
+            instance_id, algorithms, models, algo_params
+        )
+        storage.get_model_data_models().insert(Model(id=instance_id, models=blob))
+    except BaseException:
+        # no zombie TRAINING rows: mark the run aborted, then propagate
+        instance.status = instances.STATUS_ABORTED
+        instance.end_time = _dt.datetime.now(tz=UTC)
+        instances.update(instance)
+        raise
 
     instance.status = instances.STATUS_COMPLETED
     instance.end_time = _dt.datetime.now(tz=UTC)

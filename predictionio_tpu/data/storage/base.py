@@ -353,6 +353,7 @@ class EngineInstances(abc.ABC):
     STATUS_INIT = "INIT"
     STATUS_TRAINING = "TRAINING"
     STATUS_COMPLETED = "COMPLETED"
+    STATUS_ABORTED = "ABORTED"
 
     @abc.abstractmethod
     def insert(self, instance: EngineInstance) -> str:

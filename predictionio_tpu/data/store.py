@@ -1,0 +1,150 @@
+"""Engine-developer store API — what templates call to read events.
+
+Parity: ``data/.../data/store/{PEventStore,LEventStore}.scala`` and the
+appName→appId/channelId resolution in ``store/Common.scala``:
+
+* :class:`PEventStore` — bulk reads by app NAME, returning columnar
+  :class:`~predictionio_tpu.data.batch.EventBatch` (reference returns
+  ``RDD[Event]``), plus ``aggregate_properties``.
+* :class:`LEventStore` — row reads for serving-time lookups
+  (``LEventStore.findByEntity`` with a timeout is what ECommAlgorithm calls
+  per query, ``examples/.../ECommAlgorithm.scala:332-360``).
+
+The active :class:`Storage` is process-global (``set_storage``), defaulting to
+the env-configured singleton — mirroring how the reference's ``object
+Storage`` is ambient.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Optional, Sequence
+
+from predictionio_tpu.data.batch import EventBatch
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.registry import Storage
+
+_active_storage: Optional[Storage] = None
+
+
+def set_storage(storage: Optional[Storage]) -> None:
+    global _active_storage
+    _active_storage = storage
+
+
+def get_storage() -> Storage:
+    return _active_storage if _active_storage is not None else Storage.instance()
+
+
+def resolve_app(
+    app_name: str, channel_name: Optional[str] = None
+) -> tuple[int, Optional[int]]:
+    """appName (+channelName) → (appId, channelId); parity store/Common.scala."""
+    storage = get_storage()
+    app = storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise ValueError(f"Invalid app name {app_name!r}")
+    channel_id = None
+    if channel_name is not None:
+        channels = storage.get_meta_data_channels().get_by_app_id(app.id)
+        match = [c for c in channels if c.name == channel_name]
+        if not match:
+            raise ValueError(
+                f"Invalid channel name {channel_name!r} for app {app_name!r}"
+            )
+        channel_id = match[0].id
+    return app.id, channel_id
+
+
+class PEventStore:
+    """Bulk columnar reads (parity: PEventStore.find/aggregateProperties)."""
+
+    @staticmethod
+    def find(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+    ) -> EventBatch:
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        return get_storage().get_p_events().find(
+            app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
+
+    @staticmethod
+    def aggregate_properties(
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ):
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        return get_storage().get_p_events().aggregate_properties(
+            app_id,
+            entity_type,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )
+
+
+class LEventStore:
+    """Row reads for serving-time lookups (parity: LEventStore.scala:48-265)."""
+
+    @staticmethod
+    def find_by_entity(
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        limit: Optional[int] = None,
+        latest: bool = True,
+    ) -> list[Event]:
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        return list(
+            get_storage().get_l_events().find(
+                app_id,
+                channel_id=channel_id,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+                start_time=start_time,
+                until_time=until_time,
+                limit=limit,
+                reversed=latest,
+            )
+        )
+
+    @staticmethod
+    def find(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        **filters,
+    ) -> list[Event]:
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        return list(
+            get_storage().get_l_events().find(app_id, channel_id=channel_id, **filters)
+        )

@@ -1,0 +1,3 @@
+from predictionio_tpu.models.als import ALSConfig, ALSModel, train_als
+
+__all__ = ["ALSConfig", "ALSModel", "train_als"]

@@ -1,0 +1,4 @@
+from predictionio_tpu.ops.segment import segment_sum, segment_count
+from predictionio_tpu.ops.topk import top_k_with_mask
+
+__all__ = ["segment_sum", "segment_count", "top_k_with_mask"]

@@ -1,0 +1,25 @@
+"""Segment reductions: the TPU replacement for Spark's groupByKey/reduceByKey.
+
+Every "group by entity and aggregate" the reference does with RDD shuffles
+(e.g. co-occurrence self-joins, ALS normal-equation accumulation inside MLlib)
+becomes a static-shape ``segment_sum`` here: rows are pre-indexed integers and
+XLA lowers the scatter-add to fast on-chip updates, no shuffle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    """Sum ``data`` rows into ``num_segments`` buckets by ``segment_ids``.
+
+    num_segments must be static (compile-time) — pad id spaces to fixed sizes.
+    """
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_count(segment_ids: jax.Array, num_segments: int, weights=None) -> jax.Array:
+    w = jnp.ones(segment_ids.shape[0], jnp.float32) if weights is None else weights
+    return jax.ops.segment_sum(w, segment_ids, num_segments=num_segments)

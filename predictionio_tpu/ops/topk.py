@@ -1,0 +1,18 @@
+"""Top-k selection with masking — the serving-side ranking primitive."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-1e30)
+
+
+def top_k_with_mask(scores: jax.Array, k: int, mask: jax.Array | None = None):
+    """(values, indices) of the k best scores; masked slots never win.
+
+    ``mask`` is True for EXCLUDED entries (seen items, blacklist, padding).
+    """
+    if mask is not None:
+        scores = jnp.where(mask, NEG_INF, scores)
+    return jax.lax.top_k(scores, k)

@@ -1,0 +1,162 @@
+"""End-to-end recommendation template: events → train → deploy → query.
+
+Parity model: tests/pio_tests/scenarios/quickstart_test.py (SURVEY.md §4
+tier 3) minus the HTTP layer (covered by server tests).
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.workflow import (
+    get_latest_completed_instance,
+    prepare_deploy,
+    run_train,
+)
+from predictionio_tpu.data import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.data import store as store_mod
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.templates.recommendation import (
+    Query,
+    RecommendationEngine,
+)
+
+
+@pytest.fixture()
+def app_with_events(storage):
+    store_mod.set_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(App(0, "testapp"))
+    le = storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(7)
+    # two taste groups: users u0..u9 like items i0..i7, u10..u19 like i8..i15
+    for u in range(20):
+        items = range(0, 8) if u < 10 else range(8, 16)
+        for i in rng.choice(list(items), size=5, replace=False):
+            le.insert(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties={"rating": float(rng.integers(4, 6))},
+                ),
+                app_id,
+            )
+        # one buy event (weight 4.0 path)
+        le.insert(
+            Event(
+                event="buy",
+                entity_type="user",
+                entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{list(items)[0]}",
+            ),
+            app_id,
+        )
+    yield storage
+    store_mod.set_storage(None)
+
+
+VARIANT = {
+    "id": "default",
+    "engineFactory": "predictionio_tpu.templates.recommendation.RecommendationEngine",
+    "datasource": {"params": {"appName": "testapp"}},
+    "algorithms": [
+        {"name": "als", "params": {"rank": 8, "numIterations": 8, "reg": 0.01}}
+    ],
+}
+
+
+def test_end_to_end_train_deploy_query(app_with_events):
+    storage = app_with_events
+    engine = RecommendationEngine.apply()
+    ep = engine.params_from_variant(VARIANT)
+    ctx = MeshContext.create()
+    run_train(
+        engine,
+        ep,
+        engine_factory=VARIANT["engineFactory"],
+        storage=storage,
+        ctx=ctx,
+    )
+    inst = get_latest_completed_instance(storage)
+    _, algorithms, serving, models = prepare_deploy(
+        engine, inst, storage=storage, ctx=ctx
+    )
+
+    def query(q):
+        qq = serving.supplement(q)
+        preds = [a.predict(m, qq) for a, m in zip(algorithms, models)]
+        return serving.serve(qq, preds)
+
+    res = query(Query(user="u1", num=4))
+    assert len(res.itemScores) == 4
+    scores = [s.score for s in res.itemScores]
+    assert scores == sorted(scores, reverse=True)
+    # group-0 user should be recommended group-0 items predominantly
+    group0 = {f"i{i}" for i in range(8)}
+    hits = sum(1 for s in res.itemScores if s.item in group0)
+    assert hits >= 3
+
+    # unknown user → empty result (not an error)
+    assert query(Query(user="nobody", num=4)).itemScores == []
+
+    # blacklist removes items
+    top = [s.item for s in res.itemScores]
+    res_bl = query(Query(user="u1", num=4, blackList=top[:2]))
+    assert not set(top[:2]) & {s.item for s in res_bl.itemScores}
+
+    # whitelist restricts pool
+    res_wl = query(Query(user="u1", num=3, whiteList=["i1", "i2"]))
+    assert {s.item for s in res_wl.itemScores} <= {"i1", "i2"}
+
+
+def test_reference_engine_json_lambda_alias():
+    """Reference-format engine.json ("lambda" key) binds onto reg."""
+    engine = RecommendationEngine.apply()
+    ep = engine.params_from_variant(
+        {"algorithms": [{"name": "als", "params": {"rank": 5, "lambda": 0.5}}]}
+    )
+    assert ep.algorithm_params_list[0][1].reg == 0.5
+
+
+def test_failed_train_marks_instance_aborted(storage):
+    import pytest as _pytest
+
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.parallel.mesh import MeshContext
+
+    store_mod.set_storage(storage)
+    try:
+        engine = RecommendationEngine.apply()
+        ep = engine.params_from_variant(
+            {"datasource": {"params": {"appName": "no-such-app"}}}
+        )
+        with _pytest.raises(ValueError):
+            run_train(engine, ep, "x", storage=storage, ctx=MeshContext.create())
+        insts = storage.get_meta_data_engine_instances().get_all()
+        assert [i.status for i in insts] == ["ABORTED"]
+    finally:
+        store_mod.set_storage(None)
+
+
+def test_eval_read_folds(app_with_events):
+    engine = RecommendationEngine.apply()
+    variant = dict(VARIANT)
+    variant["datasource"] = {
+        "params": {"appName": "testapp", "evalParams": {"kFold": 3, "queryNum": 5}}
+    }
+    variant["algorithms"] = [
+        {"name": "als", "params": {"rank": 4, "numIterations": 3}}
+    ]
+    ep = engine.params_from_variant(variant)
+    ctx = MeshContext.create()
+    results = engine.eval(ctx, ep)
+    assert len(results) == 3
+    for _, triples in results:
+        assert triples
+        q, p, actual = triples[0]
+        assert isinstance(actual, list)  # held-out item ids
