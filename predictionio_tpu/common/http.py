@@ -1,0 +1,160 @@
+"""Minimal threaded HTTP service kit shared by all REST planes.
+
+Parity role: the reference's ``common/`` module (akka-http ``Json4sSupport``,
+``KeyAuthentication``) — the service plane stays REST (SURVEY.md §2.7); only
+the compute plane moved to XLA.  Stdlib-only (no external web framework).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    params: dict[str, str]  # query params (first value)
+    headers: Any
+    body: bytes
+    match: Optional[re.Match] = None
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+    def form(self) -> dict[str, str]:
+        pairs = urllib.parse.parse_qsl(self.body.decode("utf-8"))
+        return dict(pairs)
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: Any = None  # JSON-serializable, or str (text/html), or bytes
+    content_type: Optional[str] = None
+    headers: dict[str, str] = field(default_factory=dict)
+
+
+def json_response(status: int, obj: Any) -> Response:
+    return Response(status=status, body=obj)
+
+
+class HttpService:
+    """Route table + threaded server; handlers get Request, return Response."""
+
+    def __init__(self, name: str = "service"):
+        self.name = name
+        self.routes: list[tuple[str, re.Pattern, Callable[[Request], Response]]] = []
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def route(self, method: str, pattern: str):
+        regex = re.compile("^" + pattern + "$")
+
+        def deco(fn):
+            self.routes.append((method.upper(), regex, fn))
+            return fn
+
+        return deco
+
+    def dispatch(self, req: Request) -> Response:
+        path_matched = False
+        for method, regex, fn in self.routes:
+            m = regex.match(req.path)
+            if m:
+                path_matched = True
+                if method == req.method:
+                    req.match = m
+                    return fn(req)
+        if path_matched:
+            return json_response(405, {"message": "method not allowed"})
+        return json_response(404, {"message": "not found"})
+
+    # -- server lifecycle ---------------------------------------------------
+    def start(self, host: str = "0.0.0.0", port: int = 7070) -> int:
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # silence default stderr spam
+                pass
+
+            def _handle(self, method: str):
+                parsed = urllib.parse.urlsplit(self.path)
+                params = dict(urllib.parse.parse_qsl(parsed.query))
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                req = Request(
+                    method=method,
+                    path=parsed.path,
+                    params=params,
+                    headers=self.headers,
+                    body=body,
+                )
+                try:
+                    resp = service.dispatch(req)
+                except json.JSONDecodeError as e:
+                    resp = json_response(400, {"message": f"invalid JSON: {e}"})
+                except Exception as e:  # pragma: no cover - defensive
+                    resp = json_response(500, {"message": str(e)})
+                self._send(resp)
+
+            def _send(self, resp: Response):
+                body = resp.body
+                ctype = resp.content_type
+                if isinstance(body, bytes):
+                    payload = body
+                    ctype = ctype or "application/octet-stream"
+                elif isinstance(body, str):
+                    payload = body.encode("utf-8")
+                    ctype = ctype or "text/html; charset=utf-8"
+                else:
+                    payload = json.dumps(body).encode("utf-8")
+                    ctype = ctype or "application/json; charset=utf-8"
+                self.send_response(resp.status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        actual_port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"{self.name}-http", daemon=True
+        )
+        self._thread.start()
+        return actual_port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def serve_forever(self) -> None:
+        if self._thread is not None:
+            self._thread.join()

@@ -1,0 +1,332 @@
+"""REST Event Server: the ingestion front door.
+
+Parity: ``data/.../data/api/EventServer.scala:61-560``:
+
+* accessKey auth via ``?accessKey=`` query param or HTTP Basic username
+  (``EventServer.scala:92-130``); per-key event-name whitelist enforced.
+* ``POST /events.json`` → 201 ``{"eventId": ...}``; GET/DELETE
+  ``/events/<id>.json``; filtered ``GET /events.json`` (startTime/untilTime/
+  entityType/entityId/event/targetEntityType/targetEntityId/limit/reversed).
+* ``POST /batch/events.json`` — max **50** events/request
+  (``EventServer.scala:66``), per-item status with partial success.
+* ``GET /stats.json`` per-app ingestion counts (opt-in ``stats=True``).
+* ``POST /webhooks/<name>.json|.form`` connector adapters; GET probes
+  connector existence (``EventServer.scala:442-505``).
+* channel selection via ``?channel=<name>`` (invalid channel → 400).
+* input blocker/sniffer plugins (``EventServerPlugin``,
+  ``EventServer.scala:250-259``).
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+from typing import Optional
+
+from predictionio_tpu.common.http import HttpService, Request, Response, json_response
+from predictionio_tpu.data.api.stats import Stats
+from predictionio_tpu.data.event import Event, parse_time_or_none
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.data.webhooks.connector import (
+    ConnectorError,
+    connector_to_event,
+    get_form_connector,
+    get_json_connector,
+)
+
+logger = logging.getLogger(__name__)
+
+MAX_BATCH_SIZE = 50  # parity: EventServer.scala:66
+
+
+class EventServerPlugin:
+    """Parity: data/.../api/EventServerPlugin.scala."""
+
+    INPUT_BLOCKER = "inputblocker"
+    INPUT_SNIFFER = "inputsniffer"
+
+    name = "plugin"
+    plugin_type = INPUT_SNIFFER
+
+    def process(self, event_info: dict, context: dict) -> None:
+        """Blockers raise to reject the event; sniffers observe."""
+
+
+class EventServer:
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        stats: bool = False,
+        plugins: Optional[list[EventServerPlugin]] = None,
+    ):
+        self.storage = storage or Storage.instance()
+        self.stats_enabled = stats
+        self.stats = Stats()
+        self.plugins = list(plugins or [])
+        self.service = HttpService("eventserver")
+        self._register_routes()
+
+    # -- auth (parity: withAccessKey, EventServer.scala:92-130) ------------
+    def _authenticate(self, req: Request) -> tuple[Optional[dict], Optional[Response]]:
+        key = req.params.get("accessKey")
+        if not key:
+            auth = req.headers.get("Authorization", "")
+            if auth.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(auth[6:]).decode("utf-8")
+                    key = decoded.split(":", 1)[0]
+                except Exception:
+                    key = None
+        if not key:
+            return None, json_response(401, {"message": "Missing accessKey."})
+        access_key = self.storage.get_meta_data_access_keys().get(key)
+        if access_key is None:
+            return None, json_response(401, {"message": "Invalid accessKey."})
+        channel_id = None
+        if "channel" in req.params:
+            channels = self.storage.get_meta_data_channels().get_by_app_id(
+                access_key.app_id
+            )
+            match = [c for c in channels if c.name == req.params["channel"]]
+            if not match:
+                return None, json_response(400, {"message": "Invalid channel."})
+            channel_id = match[0].id
+        return (
+            {
+                "app_id": access_key.app_id,
+                "channel_id": channel_id,
+                "events_allowed": access_key.events,
+            },
+            None,
+        )
+
+    def _check_event_allowed(self, auth: dict, event_name: str) -> Optional[Response]:
+        allowed = auth["events_allowed"]
+        if allowed and event_name not in allowed:
+            return json_response(
+                403, {"message": f"{event_name} events are not allowed"}
+            )
+        return None
+
+    def _run_plugins(self, event: Event, auth: dict) -> Optional[Response]:
+        info = {"event": event.to_dict(), "appId": auth["app_id"]}
+        for p in self.plugins:
+            if p.plugin_type == EventServerPlugin.INPUT_BLOCKER:
+                try:
+                    p.process(info, {})
+                except Exception as e:
+                    return json_response(403, {"message": f"blocked: {e}"})
+        for p in self.plugins:
+            if p.plugin_type == EventServerPlugin.INPUT_SNIFFER:
+                try:
+                    p.process(info, {})
+                except Exception:
+                    logger.exception("sniffer plugin %s failed", p.name)
+        return None
+
+    def _insert(self, auth: dict, data: dict) -> Response:
+        try:
+            event = Event.from_dict(data)
+        except (ValueError, KeyError, TypeError) as e:
+            self.stats_update(auth, str(data.get("event", "")), 400)
+            return json_response(400, {"message": str(e)})
+        return self._insert_event(auth, event)
+
+    def _insert_event(self, auth: dict, event: Event) -> Response:
+        denied = self._check_event_allowed(auth, event.event)
+        if denied is None:
+            denied = self._run_plugins(event, auth)
+        if denied is not None:
+            self.stats_update(auth, event.event, denied.status)
+            return denied
+        le = self.storage.get_l_events()
+        le.init(auth["app_id"], auth["channel_id"])
+        event_id = le.insert(event, auth["app_id"], auth["channel_id"])
+        self.stats_update(auth, event.event, 201)
+        return json_response(201, {"eventId": event_id})
+
+    def stats_update(self, auth: dict, event_name: str, status: int) -> None:
+        if self.stats_enabled:
+            self.stats.update(auth["app_id"], event_name, status)
+
+    # -- routes --------------------------------------------------------------
+    def _register_routes(self):
+        svc = self.service
+
+        @svc.route("GET", r"/")
+        def index(req):
+            return json_response(200, {"status": "alive"})
+
+        @svc.route("POST", r"/events\.json")
+        def create_event(req):
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            data = req.json()
+            if not isinstance(data, dict):
+                return json_response(400, {"message": "request body must be a JSON object"})
+            return self._insert(auth, data)
+
+        @svc.route("GET", r"/events\.json")
+        def find_events(req):
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            p = req.params
+            try:
+                limit = int(p.get("limit", 20))
+            except ValueError:
+                return json_response(400, {"message": "limit must be an integer"})
+            try:
+                events = self.storage.get_l_events().find(
+                    auth["app_id"],
+                    channel_id=auth["channel_id"],
+                    start_time=parse_time_or_none(p.get("startTime")),
+                    until_time=parse_time_or_none(p.get("untilTime")),
+                    entity_type=p.get("entityType"),
+                    entity_id=p.get("entityId"),
+                    event_names=p["event"].split(",") if "event" in p else None,
+                    target_entity_type=p.get("targetEntityType"),
+                    target_entity_id=p.get("targetEntityId"),
+                    limit=limit,
+                    reversed=p.get("reversed") == "true",
+                )
+            except ValueError as e:
+                return json_response(400, {"message": str(e)})
+            out = [e.to_dict() for e in events]
+            if not out:
+                return json_response(404, {"message": "Not Found"})
+            return json_response(200, out)
+
+        @svc.route("GET", r"/events/(?P<eid>[^/]+)\.json")
+        def get_event(req):
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            e = self.storage.get_l_events().get(
+                req.match.group("eid"), auth["app_id"], auth["channel_id"]
+            )
+            if e is None:
+                return json_response(404, {"message": "Not Found"})
+            return json_response(200, e.to_dict())
+
+        @svc.route("DELETE", r"/events/(?P<eid>[^/]+)\.json")
+        def delete_event(req):
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            found = self.storage.get_l_events().delete(
+                req.match.group("eid"), auth["app_id"], auth["channel_id"]
+            )
+            if not found:
+                return json_response(404, {"message": "Not Found"})
+            return json_response(200, {"message": "Found"})
+
+        @svc.route("POST", r"/batch/events\.json")
+        def batch_events(req):
+            # partial-success semantics (parity: EventServer.scala:340-419)
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            data = req.json()
+            if not isinstance(data, list):
+                return json_response(400, {"message": "request body must be a JSON array"})
+            if len(data) > MAX_BATCH_SIZE:
+                return json_response(
+                    400,
+                    {
+                        "message": f"Batch request must have less than or equal to "
+                        f"{MAX_BATCH_SIZE} events"
+                    },
+                )
+            results = []
+            for item in data:
+                if not isinstance(item, dict):
+                    results.append({"status": 400, "message": "not a JSON object"})
+                    continue
+                r = self._insert(auth, item)
+                entry = dict(r.body)
+                entry["status"] = r.status
+                results.append(entry)
+            return json_response(200, results)
+
+        @svc.route("GET", r"/stats\.json")
+        def stats_route(req):
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            if not self.stats_enabled:
+                return json_response(
+                    404, {"message": "To see stats, launch the server with stats enabled."}
+                )
+            return json_response(200, self.stats.get(auth["app_id"]))
+
+        @svc.route("POST", r"/webhooks/(?P<name>[^/]+)\.json")
+        def webhook_json(req):
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            connector = get_json_connector(req.match.group("name"))
+            if connector is None:
+                return json_response(404, {"message": "Not Found"})
+            try:
+                event = connector_to_event(connector, req.json() or {})
+            except (ConnectorError, ValueError, KeyError) as e:
+                return json_response(400, {"message": str(e)})
+            return self._insert_event(auth, event)
+
+        @svc.route("GET", r"/webhooks/(?P<name>[^/]+)\.json")
+        def webhook_json_probe(req):
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            if get_json_connector(req.match.group("name")) is None:
+                return json_response(404, {"message": "Not Found"})
+            return json_response(200, {"message": "Ok"})
+
+        @svc.route("POST", r"/webhooks/(?P<name>[^/]+)\.form")
+        def webhook_form(req):
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            connector = get_form_connector(req.match.group("name"))
+            if connector is None:
+                return json_response(404, {"message": "Not Found"})
+            try:
+                event = connector_to_event(connector, req.form())
+            except (ConnectorError, ValueError, KeyError) as e:
+                return json_response(400, {"message": str(e)})
+            return self._insert_event(auth, event)
+
+        @svc.route("GET", r"/webhooks/(?P<name>[^/]+)\.form")
+        def webhook_form_probe(req):
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            if get_form_connector(req.match.group("name")) is None:
+                return json_response(404, {"message": "Not Found"})
+            return json_response(200, {"message": "Ok"})
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, host: str = "0.0.0.0", port: int = 7070) -> int:
+        actual = self.service.start(host, port)
+        logger.info("event server listening on %s:%s", host, actual)
+        return actual
+
+    def stop(self) -> None:
+        self.service.stop()
+
+
+def register_builtin_connectors() -> None:
+    from predictionio_tpu.data.webhooks.connector import (
+        register_form_connector,
+        register_json_connector,
+    )
+    from predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
+    from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
+
+    register_json_connector("segmentio", SegmentIOConnector())
+    register_form_connector("mailchimp", MailChimpConnector())
+
+
+register_builtin_connectors()

@@ -1,0 +1,34 @@
+"""Event-server ingestion metrics.
+
+Parity: ``data/.../api/Stats.scala:28-80`` + ``StatsActor.scala:30-76`` —
+per-app counts keyed by (event name, status code) since server start,
+exposed at ``/stats.json``.  A lock replaces the actor mailbox.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from collections import Counter
+
+
+class Stats:
+    def __init__(self):
+        self.start_time = _dt.datetime.now(tz=_dt.timezone.utc)
+        self._lock = threading.Lock()
+        self._counts: dict[int, Counter] = {}
+
+    def update(self, app_id: int, event_name: str, status_code: int) -> None:
+        with self._lock:
+            self._counts.setdefault(app_id, Counter())[(event_name, status_code)] += 1
+
+    def get(self, app_id: int) -> dict:
+        with self._lock:
+            counts = self._counts.get(app_id, Counter())
+            return {
+                "startTime": self.start_time.isoformat(),
+                "statusCount": [
+                    {"event": ev, "status": status, "count": n}
+                    for (ev, status), n in sorted(counts.items())
+                ],
+            }

@@ -45,6 +45,10 @@ def _parse_time(v: Any) -> _dt.datetime:
     raise ValueError(f"cannot parse time: {v!r}")
 
 
+def parse_time_or_none(v: Any) -> Optional[_dt.datetime]:
+    return None if v is None else _parse_time(v)
+
+
 def format_time(d: _dt.datetime) -> str:
     return d.astimezone(UTC).isoformat(timespec="milliseconds").replace("+00:00", "Z")
 

@@ -1,0 +1,19 @@
+from predictionio_tpu.data.webhooks.connector import (
+    ConnectorError,
+    FormConnector,
+    JsonConnector,
+    get_form_connector,
+    get_json_connector,
+    register_form_connector,
+    register_json_connector,
+)
+
+__all__ = [
+    "ConnectorError",
+    "FormConnector",
+    "JsonConnector",
+    "get_form_connector",
+    "get_json_connector",
+    "register_form_connector",
+    "register_json_connector",
+]

@@ -171,7 +171,7 @@ def _half_step_local(
 
     # carries differ per shard → mark them varying over the mesh axis
     init = jax.tree.map(
-        lambda z: jax.lax.pvary(z, (DATA_AXIS,)),
+        lambda z: jax.lax.pcast(z, DATA_AXIS, to="varying"),
         (
             jnp.zeros((per_shard, rank, rank), jnp.float32),
             jnp.zeros((per_shard, rank), jnp.float32),
@@ -358,8 +358,10 @@ class ALSScorer:
             keep = np.zeros(self._n_items_pad, bool)
             keep[np.asarray(candidate_items, np.int64)] = True
             mask |= ~keep
-        k = min(max(num, 1), self.n_items, self.max_k)
-        if self.on_device:
+        k = min(max(num, 1), self.n_items)
+        # num beyond the compiled top-k width serves exactly from host
+        # rather than silently truncating to max_k
+        if self.on_device and k <= self._k:
             vals, idx = self._score(
                 self._U, self._V, self._pad_mask, user_idx, jnp.asarray(mask)
             )

@@ -1,0 +1,71 @@
+"""Batch predict: bulk queries from a file, predictions to a file.
+
+Parity: ``core/.../workflow/BatchPredict.scala:120-235`` — one JSON query per
+input line; each line is parsed → supplemented → predicted per algorithm →
+served → rendered as one JSON line.  Where the reference maps over a query
+RDD on Spark executors, this streams through the in-process engine (the
+per-query predict itself runs on-device for sharded models).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core.workflow import (
+    get_latest_completed_instance,
+    prepare_deploy,
+)
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.serving.query_server import _to_jsonable, bind_query
+
+logger = logging.getLogger(__name__)
+
+
+def run_batch_predict(
+    engine: Engine,
+    input_path: str,
+    output_path: str,
+    storage: Optional[Storage] = None,
+    ctx: Optional[MeshContext] = None,
+    engine_id: str = "default",
+    engine_version: str = "default",
+    engine_variant: str = "default",
+) -> int:
+    """Returns the number of predictions written."""
+    storage = storage or Storage.instance()
+    ctx = ctx or MeshContext.create()
+    instance = get_latest_completed_instance(
+        storage, engine_id, engine_version, engine_variant
+    )
+    _, algorithms, serving, models = prepare_deploy(
+        engine, instance, storage=storage, ctx=ctx
+    )
+    n = 0
+    with open(input_path) as fin, open(output_path, "w") as fout:
+        for line_no, line in enumerate(fin, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                data = json.loads(line)
+                query = bind_query(engine.query_cls, data)
+                supplemented = serving.supplement(query)
+                predictions = [
+                    a.predict(m, supplemented) for a, m in zip(algorithms, models)
+                ]
+                result = serving.serve(supplemented, predictions)
+                fout.write(
+                    json.dumps(
+                        {"query": data, "prediction": _to_jsonable(result)}
+                    )
+                    + "\n"
+                )
+                n += 1
+            except Exception as e:
+                logger.warning("line %d failed: %s", line_no, e)
+                fout.write(json.dumps({"query": line, "error": str(e)}) + "\n")
+    return n

@@ -1,0 +1,274 @@
+"""Query server: low-latency REST serving of deployed engines.
+
+Parity: ``core/.../workflow/CreateServer.scala:104-706``:
+
+* ``POST /queries.json`` — parse query → ``serving.supplement`` → per-algorithm
+  ``predict`` → ``serving.serve`` (the in-process hot loop,
+  ``CreateServer.scala:484-634``).
+* ``GET /`` — server info with request count / avg / last serving seconds
+  (``:415-417,597-604``).
+* ``GET|POST /reload`` — hot-swap to the latest COMPLETED instance without
+  dropping queries (``:342-371,635-642``); models are re-placed on the mesh
+  and the handle swapped atomically.
+* ``POST /stop`` — undeploy (``commands/Engine.scala:245-268`` calls this).
+* ``GET /plugins.json`` + outputblocker/outputsniffer plugin hooks
+  (``EngineServerPlugin.scala:24-40``, ``CreateServer.scala:591-595,656-702``).
+* feedback loop: when enabled, every prediction is POSTed back to the event
+  server tagged with ``prId`` (``CreateServer.scala:527-589``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import secrets
+import threading
+import time
+import urllib.request
+from typing import Any, Optional
+
+from predictionio_tpu.common.http import HttpService, Request, json_response
+from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core.workflow import (
+    get_latest_completed_instance,
+    prepare_deploy,
+)
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.parallel.mesh import MeshContext
+
+logger = logging.getLogger(__name__)
+
+
+class EngineServerPlugin:
+    """Parity: workflow/EngineServerPlugin.scala:24-40."""
+
+    OUTPUT_BLOCKER = "outputblocker"
+    OUTPUT_SNIFFER = "outputsniffer"
+
+    name = "plugin"
+    plugin_type = OUTPUT_SNIFFER
+
+    def process(self, query: Any, prediction: Any, context: dict) -> Any:
+        """Blockers return a (possibly rewritten) prediction; sniffers observe."""
+        return prediction
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    return obj
+
+
+def bind_query(query_cls: Optional[type], data: dict) -> Any:
+    """Lenient query binding (parity: JsonExtractor dual Gson/json4s path —
+    unknown JSON fields are ignored, missing ones take defaults)."""
+    if query_cls is None or not dataclasses.is_dataclass(query_cls):
+        return data
+    names = {f.name for f in dataclasses.fields(query_cls)}
+    return query_cls(**{k: v for k, v in data.items() if k in names})
+
+
+@dataclasses.dataclass
+class _Deployed:
+    instance_id: str
+    algorithms: list
+    serving: Any
+    models: list
+    start_time: float
+
+
+class QueryServer:
+    def __init__(
+        self,
+        engine: Engine,
+        storage: Optional[Storage] = None,
+        ctx: Optional[MeshContext] = None,
+        engine_id: str = "default",
+        engine_version: str = "default",
+        engine_variant: str = "default",
+        feedback: bool = False,
+        event_server_url: Optional[str] = None,
+        access_key: Optional[str] = None,
+        plugins: Optional[list[EngineServerPlugin]] = None,
+    ):
+        self.engine = engine
+        self.storage = storage or Storage.instance()
+        self.ctx = ctx or MeshContext.create()
+        self.engine_id = engine_id
+        self.engine_version = engine_version
+        self.engine_variant = engine_variant
+        self.feedback = feedback
+        self.event_server_url = event_server_url
+        self.access_key = access_key
+        self.plugins = list(plugins or [])
+        self._deployed: Optional[_Deployed] = None
+        self._lock = threading.Lock()
+        # latency bookkeeping (parity: CreateServer.scala:415-417)
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self.service = HttpService("queryserver")
+        self._register_routes()
+        self.reload()
+
+    # -- model lifecycle -----------------------------------------------------
+    def reload(self) -> str:
+        """(Re)load the latest COMPLETED instance; atomic swap."""
+        instance = get_latest_completed_instance(
+            self.storage, self.engine_id, self.engine_version, self.engine_variant
+        )
+        _, algorithms, serving, models = prepare_deploy(
+            self.engine, instance, storage=self.storage, ctx=self.ctx
+        )
+        deployed = _Deployed(
+            instance_id=instance.id,
+            algorithms=algorithms,
+            serving=serving,
+            models=models,
+            start_time=time.time(),
+        )
+        with self._lock:
+            self._deployed = deployed
+        logger.info("deployed engine instance %s", instance.id)
+        return instance.id
+
+    # -- query hot loop (parity: CreateServer.scala:484-634) -----------------
+    def handle_query(self, data: dict) -> dict:
+        t0 = time.perf_counter()
+        with self._lock:
+            deployed = self._deployed
+        query = bind_query(self.engine.query_cls, data)
+        supplemented = deployed.serving.supplement(query)
+        predictions = [
+            algo.predict(model, supplemented)
+            for algo, model in zip(deployed.algorithms, deployed.models)
+        ]
+        prediction = deployed.serving.serve(supplemented, predictions)
+        # plugins see JSON values, as in the reference (JValue-based process)
+        result = _to_jsonable(prediction)
+        for p in self.plugins:
+            if p.plugin_type == EngineServerPlugin.OUTPUT_BLOCKER:
+                result = p.process(supplemented, result, {})
+        for p in self.plugins:
+            if p.plugin_type == EngineServerPlugin.OUTPUT_SNIFFER:
+                try:
+                    p.process(supplemented, result, {})
+                except Exception:
+                    logger.exception("sniffer plugin %s failed", p.name)
+        if self.feedback:
+            pr_id = data.get("prId") or secrets.token_hex(8)
+            result["prId"] = pr_id
+            self._send_feedback(data, result, pr_id, deployed.instance_id)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.request_count += 1
+            self.last_serving_sec = dt
+            self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+        return result
+
+    def _send_feedback(self, query, prediction, pr_id, instance_id) -> None:
+        """Async POST back to the event server (CreateServer.scala:563-569)."""
+        if not self.event_server_url:
+            return
+
+        def post():
+            try:
+                event = {
+                    "event": "predict",
+                    "entityType": "pio_pr",
+                    "entityId": pr_id,
+                    "properties": {
+                        "engineInstanceId": instance_id,
+                        "query": query,
+                        "prediction": prediction,
+                    },
+                }
+                url = f"{self.event_server_url}/events.json"
+                if self.access_key:
+                    url += f"?accessKey={self.access_key}"
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(event).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=5)
+            except Exception:
+                logger.exception("feedback POST failed")
+
+        threading.Thread(target=post, daemon=True).start()
+
+    # -- routes ----------------------------------------------------------------
+    def _register_routes(self):
+        svc = self.service
+
+        @svc.route("GET", r"/")
+        def index(req: Request):
+            with self._lock:
+                d = self._deployed
+                info = {
+                    "status": "alive",
+                    "engineInstanceId": d.instance_id if d else None,
+                    "engineVariant": self.engine_variant,
+                    "startTime": d.start_time if d else None,
+                    "requestCount": self.request_count,
+                    "avgServingSec": self.avg_serving_sec,
+                    "lastServingSec": self.last_serving_sec,
+                    "feedback": self.feedback,
+                }
+            return json_response(200, info)
+
+        @svc.route("POST", r"/queries\.json")
+        def queries(req: Request):
+            data = req.json()
+            if not isinstance(data, dict):
+                return json_response(400, {"message": "query must be a JSON object"})
+            try:
+                return json_response(200, self.handle_query(data))
+            except TypeError as e:
+                return json_response(400, {"message": str(e)})
+
+        @svc.route("GET", r"/reload")
+        @svc.route("POST", r"/reload")
+        def reload_route(req: Request):
+            iid = self.reload()
+            return json_response(200, {"message": "Reloaded", "engineInstanceId": iid})
+
+        @svc.route("POST", r"/stop")
+        def stop_route(req: Request):
+            threading.Thread(target=self.service.stop, daemon=True).start()
+            return json_response(200, {"message": "Shutting down."})
+
+        @svc.route("GET", r"/plugins\.json")
+        def plugins_route(req: Request):
+            return json_response(
+                200,
+                {
+                    "plugins": {
+                        "outputblockers": {
+                            p.name: {"class": type(p).__name__}
+                            for p in self.plugins
+                            if p.plugin_type == EngineServerPlugin.OUTPUT_BLOCKER
+                        },
+                        "outputsniffers": {
+                            p.name: {"class": type(p).__name__}
+                            for p in self.plugins
+                            if p.plugin_type == EngineServerPlugin.OUTPUT_SNIFFER
+                        },
+                    }
+                },
+            )
+
+    # -- lifecycle ---------------------------------------------------------------
+    def start(self, host: str = "0.0.0.0", port: int = 8000) -> int:
+        actual = self.service.start(host, port)
+        logger.info("query server listening on %s:%s", host, actual)
+        return actual
+
+    def stop(self) -> None:
+        self.service.stop()

@@ -32,6 +32,8 @@ from predictionio_tpu.core import (
     Preparator,
 )
 from predictionio_tpu.core.controller import SanityCheck
+from predictionio_tpu.core.evaluation import EngineParamsGenerator, Evaluation
+from predictionio_tpu.core.metrics import OptionAverageMetric
 from predictionio_tpu.data.batch import Interactions
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.models.als import ALSConfig, ALSModel, ALSScorer, train_als
@@ -143,17 +145,18 @@ class RecommendationDataSource(DataSource):
             tu, ti = inter.user[test_sel], inter.item[test_sel]
             order = np.argsort(tu, kind="stable")
             tu, ti = tu[order], ti[order]
-            bounds = np.flatnonzero(np.diff(tu)) + 1
             qa = []
-            for us, items in zip(
-                np.split(tu, bounds), np.split(ti, bounds)
-            ):
-                qa.append(
-                    (
-                        Query(user=inv_u[int(us[0])], num=query_num),
-                        [inv_i[int(i)] for i in items],  # actual: held-out items
+            if len(tu):
+                bounds = np.flatnonzero(np.diff(tu)) + 1
+                for us, items in zip(
+                    np.split(tu, bounds), np.split(ti, bounds)
+                ):
+                    qa.append(
+                        (
+                            Query(user=inv_u[int(us[0])], num=query_num),
+                            [inv_i[int(i)] for i in items],  # actual: held-out
+                        )
                     )
-                )
             folds.append((td, qa))
         return folds
 
@@ -245,6 +248,62 @@ class ALSAlgorithm(Algorithm):
                 for i, s in zip(idx, scores)
             ]
         )
+
+
+# -- Evaluation (parity: examples/.../Evaluation.scala Precision@K) ----------
+
+
+class PrecisionAtK(OptionAverageMetric):
+    """Fraction of top-k recommendations that are in the held-out actuals.
+
+    Users with no recommendations (unknown at train time) score None and are
+    excluded, matching the reference's OptionAverageMetric usage.
+    """
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"Precision@{self.k}"
+
+    def calculate_one(self, query, prediction, actual) -> Optional[float]:
+        if not prediction.itemScores:
+            return None
+        top = [s.item for s in prediction.itemScores[: self.k]]
+        positives = set(actual)
+        if not top or not positives:
+            return None
+        # tp / min(k, |positives|) — reference formula (Evaluation.scala)
+        tp = sum(1 for it in top if it in positives)
+        return tp / min(self.k, len(positives))
+
+
+class RecommendationEvaluation(Evaluation, EngineParamsGenerator):
+    """Grid over ALS rank (parity: Evaluation.scala + ParamsList)."""
+
+    def __init__(self, app_name: str = "default", ranks=(4, 8), k: int = 10):
+        self.engine = RecommendationEngine.apply()
+        self.metric = PrecisionAtK(k=k)
+        self.engine_params_list = [
+            self.engine.params_from_variant(
+                {
+                    "datasource": {
+                        "params": {
+                            "appName": app_name,
+                            "evalParams": {"kFold": 3, "queryNum": k},
+                        }
+                    },
+                    "algorithms": [
+                        {
+                            "name": "als",
+                            "params": {"rank": r, "numIterations": 5},
+                        }
+                    ],
+                }
+            )
+            for r in ranks
+        ]
 
 
 # -- Engine factory ---------------------------------------------------------

@@ -1,0 +1,521 @@
+"""``pio`` CLI: the operator surface.
+
+Parity: ``tools/.../console/Console.scala:134-827`` verb tree (app/accesskey/
+channel CRUD, train, deploy, undeploy, eval, batchpredict, eventserver,
+adminserver, dashboard, status, export, import, build, version).  Structural
+difference from the reference: no spark-submit hop — ``train``/``deploy`` run
+in-process against the device mesh (``Runner.runOnSpark`` has no equivalent;
+SURVEY.md §7).
+
+Usage: ``python -m predictionio_tpu.tools.cli <verb> ...`` (or the ``pio``
+console script).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Optional
+
+from predictionio_tpu import __version__
+
+logger = logging.getLogger("pio")
+
+
+def _storage():
+    from predictionio_tpu.data.storage.registry import Storage
+
+    return Storage.instance()
+
+
+def _die(msg: str, code: int = 1) -> int:
+    print(f"[ERROR] {msg}", file=sys.stderr)
+    return code
+
+
+# -- engine.json handling ----------------------------------------------------
+
+
+def load_variant(args) -> dict:
+    path = getattr(args, "variant", None) or os.path.join(
+        getattr(args, "engine_dir", None) or os.getcwd(), "engine.json"
+    )
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found. Run from an engine directory or pass --variant."
+        )
+    with open(path) as f:
+        variant = json.load(f)
+    if "engineFactory" not in variant:
+        raise ValueError(f"{path} has no engineFactory field")
+    return variant
+
+
+def engine_identity(variant: dict) -> tuple[str, str, str]:
+    """(engine_id, engine_version, engine_variant) from the variant JSON."""
+    return (
+        variant.get("engineId", variant["engineFactory"]),
+        variant.get("engineVersion", "default"),
+        variant.get("id", "default"),
+    )
+
+
+def resolve_engine_from_variant(variant: dict):
+    from predictionio_tpu.core.workflow import resolve_engine
+
+    return resolve_engine(variant["engineFactory"])
+
+
+def make_ctx(variant: dict):
+    from predictionio_tpu.parallel.mesh import MeshContext
+
+    conf = variant.get("mesh") or {}
+    return MeshContext.create(conf=conf)
+
+
+# -- verbs --------------------------------------------------------------------
+
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def cmd_status(args) -> int:
+    # parity: `pio status` → Storage.verifyAllDataObjects smoke check
+    try:
+        storage = _storage()
+        ok = storage.verify_all_data_objects()
+    except Exception as e:
+        return _die(f"Unable to connect to all storage backends: {e}")
+    if ok:
+        print("[INFO] All storage backends are properly configured.")
+        print("Your system is all ready to go.")
+        return 0
+    return _die("Storage verification failed.")
+
+
+def cmd_build(args) -> int:
+    """Compile check: resolve the engine factory and bind the variant params."""
+    variant = load_variant(args)
+    engine = resolve_engine_from_variant(variant)
+    engine.params_from_variant(variant)
+    print(f"[INFO] Engine {variant['engineFactory']} is ready for training.")
+    return 0
+
+
+def cmd_app(args) -> int:
+    from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+
+    storage = _storage()
+    apps = storage.get_meta_data_apps()
+    keys = storage.get_meta_data_access_keys()
+    channels = storage.get_meta_data_channels()
+
+    if args.app_command == "new":
+        app_id = apps.insert(App(0, args.name, args.description))
+        if app_id is None:
+            return _die(f"App {args.name} already exists.")
+        storage.get_l_events().init(app_id)
+        key = keys.insert(AccessKey(args.access_key or "", app_id, []))
+        print(f"[INFO] App created: ID {app_id}, Name {args.name}.")
+        print(f"[INFO] Access Key: {key}")
+        return 0
+    if args.app_command == "list":
+        print(f"{'ID':>4} {'Name':<24} Access Key")
+        for app in apps.get_all():
+            for k in keys.get_by_app_id(app.id) or [None]:
+                print(f"{app.id:>4} {app.name:<24} {k.key if k else '-'}")
+        return 0
+    if args.app_command == "show":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            return _die(f"App {args.name} does not exist.")
+        print(f"[INFO] App: ID {app.id}, Name {app.name}, Desc {app.description}")
+        for k in keys.get_by_app_id(app.id):
+            allowed = "(all)" if not k.events else ",".join(k.events)
+            print(f"[INFO] Access Key: {k.key} | Events: {allowed}")
+        for c in channels.get_by_app_id(app.id):
+            print(f"[INFO] Channel: ID {c.id}, Name {c.name}")
+        return 0
+    if args.app_command == "delete":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            return _die(f"App {args.name} does not exist.")
+        for c in channels.get_by_app_id(app.id):
+            storage.get_l_events().remove(app.id, c.id)
+            channels.delete(c.id)
+        storage.get_l_events().remove(app.id)
+        for k in keys.get_by_app_id(app.id):
+            keys.delete(k.key)
+        apps.delete(app.id)
+        print(f"[INFO] App {args.name} deleted.")
+        return 0
+    if args.app_command == "data-delete":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            return _die(f"App {args.name} does not exist.")
+        if args.channel:
+            match = [
+                c for c in channels.get_by_app_id(app.id) if c.name == args.channel
+            ]
+            if not match:
+                return _die(f"Channel {args.channel} does not exist.")
+            storage.get_l_events().remove(app.id, match[0].id)
+            storage.get_l_events().init(app.id, match[0].id)
+        else:
+            storage.get_l_events().remove(app.id)
+            storage.get_l_events().init(app.id)
+        print(f"[INFO] Data of app {args.name} deleted.")
+        return 0
+    if args.app_command == "channel-new":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            return _die(f"App {args.name} does not exist.")
+        cid = channels.insert(Channel(0, args.channel, app.id))
+        if cid is None:
+            return _die(f"Invalid channel name {args.channel}.")
+        storage.get_l_events().init(app.id, cid)
+        print(f"[INFO] Channel created: ID {cid}, Name {args.channel}.")
+        return 0
+    if args.app_command == "channel-delete":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            return _die(f"App {args.name} does not exist.")
+        match = [c for c in channels.get_by_app_id(app.id) if c.name == args.channel]
+        if not match:
+            return _die(f"Channel {args.channel} does not exist.")
+        storage.get_l_events().remove(app.id, match[0].id)
+        channels.delete(match[0].id)
+        print(f"[INFO] Channel {args.channel} deleted.")
+        return 0
+    return _die(f"unknown app command {args.app_command}")
+
+
+def cmd_accesskey(args) -> int:
+    from predictionio_tpu.data.storage.base import AccessKey
+
+    storage = _storage()
+    keys = storage.get_meta_data_access_keys()
+    if args.ak_command == "new":
+        app = storage.get_meta_data_apps().get_by_name(args.app_name)
+        if app is None:
+            return _die(f"App {args.app_name} does not exist.")
+        key = keys.insert(AccessKey("", app.id, args.event or []))
+        print(f"[INFO] Access Key: {key}")
+        return 0
+    if args.ak_command == "list":
+        for k in keys.get_all():
+            print(f"{k.key} | app {k.app_id} | events {k.events or '(all)'}")
+        return 0
+    if args.ak_command == "delete":
+        if keys.delete(args.key):
+            print("[INFO] Deleted.")
+            return 0
+        return _die("Key not found.")
+    return _die(f"unknown accesskey command {args.ak_command}")
+
+
+def cmd_train(args) -> int:
+    from predictionio_tpu.core.workflow import WorkflowParams, run_train
+
+    variant = load_variant(args)
+    engine = resolve_engine_from_variant(variant)
+    engine_params = engine.params_from_variant(variant)
+    engine_id, engine_version, engine_variant = engine_identity(variant)
+    ctx = make_ctx(variant)
+    wp = WorkflowParams(
+        batch=args.batch or "",
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+    instance_id = run_train(
+        engine,
+        engine_params,
+        engine_factory=variant["engineFactory"],
+        storage=_storage(),
+        ctx=ctx,
+        workflow_params=wp,
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+    )
+    print(f"[INFO] Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from predictionio_tpu.core.evaluation import run_evaluation
+
+    # an explicit variant supplies the mesh configuration for the eval run
+    variant = load_variant(args) if (args.variant or args.engine_dir) else None
+    result = run_evaluation(
+        evaluation_class=args.evaluation_class,
+        engine_params_generator_class=args.engine_params_generator_class,
+        storage=_storage(),
+        ctx=make_ctx(variant) if variant else None,
+        batch=args.batch or "",
+    )
+    print(f"[INFO] Evaluation completed. Instance ID: {result.instance_id}")
+    print(result.summary)
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from predictionio_tpu.serving.query_server import QueryServer
+
+    variant = load_variant(args)
+    engine = resolve_engine_from_variant(variant)
+    engine_id, engine_version, engine_variant = engine_identity(variant)
+    qs = QueryServer(
+        engine,
+        storage=_storage(),
+        ctx=make_ctx(variant),
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        feedback=args.feedback,
+        event_server_url=(
+            f"http://{args.event_server_ip}:{args.event_server_port}"
+            if args.feedback
+            else None
+        ),
+        access_key=args.accesskey,
+    )
+    port = qs.start(args.ip, args.port)
+    print(f"[INFO] Engine is deployed and running. Engine API is live at "
+          f"http://{args.ip}:{port}.")
+    try:
+        qs.service.serve_forever()
+    except KeyboardInterrupt:
+        qs.stop()
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    try:
+        with urllib.request.urlopen(
+            urllib.request.Request(url, method="POST"), timeout=5
+        ) as r:
+            print(f"[INFO] {r.read().decode()}")
+        return 0
+    except Exception as e:
+        return _die(f"Undeploy failed: {e}")
+
+
+def cmd_batchpredict(args) -> int:
+    from predictionio_tpu.serving.batch_predict import run_batch_predict
+
+    variant = load_variant(args)
+    engine = resolve_engine_from_variant(variant)
+    engine_id, engine_version, engine_variant = engine_identity(variant)
+    n = run_batch_predict(
+        engine,
+        args.input,
+        args.output,
+        storage=_storage(),
+        ctx=make_ctx(variant),
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+    )
+    print(f"[INFO] Batch predict completed: {n} predictions -> {args.output}")
+    return 0
+
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.data.api.event_server import EventServer
+
+    es = EventServer(storage=_storage(), stats=args.stats)
+    port = es.start(args.ip, args.port)
+    print(f"[INFO] Event Server is listening at http://{args.ip}:{port}")
+    try:
+        es.service.serve_forever()
+    except KeyboardInterrupt:
+        es.stop()
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.tools.admin import AdminServer
+
+    server = AdminServer(storage=_storage())
+    port = server.start(args.ip, args.port)
+    print(f"[INFO] Admin Server is listening at http://{args.ip}:{port}")
+    try:
+        server.service.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.tools.dashboard import Dashboard
+
+    server = Dashboard(storage=_storage())
+    port = server.start(args.ip, args.port)
+    print(f"[INFO] Dashboard is listening at http://{args.ip}:{port}")
+    try:
+        server.service.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def cmd_export(args) -> int:
+    from predictionio_tpu.tools.export_import import export_events
+
+    n = export_events(_storage(), args.appid, args.output, channel=args.channel)
+    print(f"[INFO] Exported {n} events to {args.output}")
+    return 0
+
+
+def cmd_import(args) -> int:
+    from predictionio_tpu.tools.export_import import import_events
+
+    n = import_events(_storage(), args.appid, args.input, channel=args.channel)
+    print(f"[INFO] Imported {n} events.")
+    return 0
+
+
+# -- parser --------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio", description="TPU-native ML serving platform CLI"
+    )
+    p.add_argument("--verbose", action="store_true")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version").set_defaults(func=cmd_version)
+    sub.add_parser("status").set_defaults(func=cmd_status)
+
+    def add_engine_args(sp):
+        sp.add_argument("--engine-dir", default=None)
+        sp.add_argument("--variant", "-v", default=None)
+
+    sp = sub.add_parser("build")
+    add_engine_args(sp)
+    sp.set_defaults(func=cmd_build)
+
+    sp = sub.add_parser("app")
+    app_sub = sp.add_subparsers(dest="app_command", required=True)
+    x = app_sub.add_parser("new")
+    x.add_argument("name")
+    x.add_argument("--description", default=None)
+    x.add_argument("--access-key", default=None)
+    app_sub.add_parser("list")
+    x = app_sub.add_parser("show")
+    x.add_argument("name")
+    x = app_sub.add_parser("delete")
+    x.add_argument("name")
+    x = app_sub.add_parser("data-delete")
+    x.add_argument("name")
+    x.add_argument("--channel", default=None)
+    x = app_sub.add_parser("channel-new")
+    x.add_argument("name")
+    x.add_argument("channel")
+    x = app_sub.add_parser("channel-delete")
+    x.add_argument("name")
+    x.add_argument("channel")
+    sp.set_defaults(func=cmd_app)
+
+    sp = sub.add_parser("accesskey")
+    ak_sub = sp.add_subparsers(dest="ak_command", required=True)
+    x = ak_sub.add_parser("new")
+    x.add_argument("app_name")
+    x.add_argument("event", nargs="*")
+    ak_sub.add_parser("list")
+    x = ak_sub.add_parser("delete")
+    x.add_argument("key")
+    sp.set_defaults(func=cmd_accesskey)
+
+    sp = sub.add_parser("train")
+    add_engine_args(sp)
+    sp.add_argument("--batch", default="")
+    sp.add_argument("--skip-sanity-check", action="store_true")
+    sp.add_argument("--stop-after-read", action="store_true")
+    sp.add_argument("--stop-after-prepare", action="store_true")
+    sp.set_defaults(func=cmd_train)
+
+    sp = sub.add_parser("eval")
+    sp.add_argument("evaluation_class")
+    sp.add_argument("engine_params_generator_class", nargs="?", default=None)
+    add_engine_args(sp)
+    sp.add_argument("--batch", default="")
+    sp.set_defaults(func=cmd_eval)
+
+    sp = sub.add_parser("deploy")
+    add_engine_args(sp)
+    sp.add_argument("--ip", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=8000)
+    sp.add_argument("--feedback", action="store_true")
+    sp.add_argument("--event-server-ip", default="0.0.0.0")
+    sp.add_argument("--event-server-port", type=int, default=7070)
+    sp.add_argument("--accesskey", default=None)
+    sp.set_defaults(func=cmd_deploy)
+
+    sp = sub.add_parser("undeploy")
+    sp.add_argument("--ip", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8000)
+    sp.set_defaults(func=cmd_undeploy)
+
+    sp = sub.add_parser("batchpredict")
+    add_engine_args(sp)
+    sp.add_argument("--input", required=True)
+    sp.add_argument("--output", required=True)
+    sp.set_defaults(func=cmd_batchpredict)
+
+    sp = sub.add_parser("eventserver")
+    sp.add_argument("--ip", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=7070)
+    sp.add_argument("--stats", action="store_true")
+    sp.set_defaults(func=cmd_eventserver)
+
+    sp = sub.add_parser("adminserver")
+    sp.add_argument("--ip", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=7071)
+    sp.set_defaults(func=cmd_adminserver)
+
+    sp = sub.add_parser("dashboard")
+    sp.add_argument("--ip", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=9000)
+    sp.set_defaults(func=cmd_dashboard)
+
+    sp = sub.add_parser("export")
+    sp.add_argument("--appid", type=int, required=True)
+    sp.add_argument("--output", required=True)
+    sp.add_argument("--channel", default=None)
+    sp.set_defaults(func=cmd_export)
+
+    sp = sub.add_parser("import")
+    sp.add_argument("--appid", type=int, required=True)
+    sp.add_argument("--input", required=True)
+    sp.add_argument("--channel", default=None)
+    sp.set_defaults(func=cmd_import)
+
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.WARNING,
+        format="[%(levelname)s] [%(name)s] %(message)s",
+    )
+    try:
+        return args.func(args)
+    except (FileNotFoundError, ValueError, RuntimeError) as e:
+        return _die(str(e))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Event export/import as JSON-lines files.
+
+Parity: ``tools/.../export/EventsToFile.scala`` and
+``imprt/FileToEvents.scala`` (the ``pio export`` / ``pio import`` verbs) —
+one Event JSON per line, the reference's interchange format.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.registry import Storage
+
+
+def _channel_id(storage: Storage, app_id: int, channel: Optional[str]) -> Optional[int]:
+    if channel is None:
+        return None
+    match = [
+        c
+        for c in storage.get_meta_data_channels().get_by_app_id(app_id)
+        if c.name == channel
+    ]
+    if not match:
+        raise ValueError(f"channel {channel!r} not found for app {app_id}")
+    return match[0].id
+
+
+def export_events(
+    storage: Storage, app_id: int, output_path: str, channel: Optional[str] = None
+) -> int:
+    channel_id = _channel_id(storage, app_id, channel)
+    n = 0
+    with open(output_path, "w") as f:
+        for e in storage.get_l_events().find(app_id, channel_id=channel_id):
+            f.write(e.to_json() + "\n")
+            n += 1
+    return n
+
+
+def import_events(
+    storage: Storage, app_id: int, input_path: str, channel: Optional[str] = None
+) -> int:
+    channel_id = _channel_id(storage, app_id, channel)
+    le = storage.get_l_events()
+    le.init(app_id, channel_id)
+    events = []
+    with open(input_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(Event.from_json(line))
+    le.batch_insert(events, app_id, channel_id)
+    return len(events)

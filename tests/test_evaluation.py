@@ -1,0 +1,165 @@
+"""Metric hierarchy + MetricEvaluator grid search + FastEval memoization.
+
+Parity model: core/src/test/.../controller/{MetricTest,MetricEvaluatorTest,
+FastEvalEngineTest}.scala.
+"""
+
+import json
+
+import pytest
+
+from predictionio_tpu.core.engine import EngineParams
+from predictionio_tpu.core.evaluation import (
+    FastEvalCache,
+    MetricEvaluator,
+    run_evaluation,
+)
+from predictionio_tpu.core.metrics import (
+    AverageMetric,
+    OptionAverageMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.parallel.mesh import MeshContext
+
+from sample_engine import AlgoParams, DSParams, PrepParams, make_engine
+
+
+class QMetric(AverageMetric):
+    def calculate_one(self, q, p, a):
+        return float(q.q)
+
+
+class OptMetric(OptionAverageMetric):
+    def calculate_one(self, q, p, a):
+        return None if q.q == 0 else float(q.q)
+
+
+class SMetric(StdevMetric):
+    def calculate_one(self, q, p, a):
+        return float(q.q)
+
+
+class SumQ(SumMetric):
+    def calculate_one(self, q, p, a):
+        return float(q.q)
+
+
+FOLDS = [
+    (0, [(type("Q", (), {"q": 0})(), None, None), (type("Q", (), {"q": 2})(), None, None)]),
+    (1, [(type("Q", (), {"q": 4})(), None, None)]),
+]
+
+
+class TestMetrics:
+    def test_average(self):
+        assert QMetric().calculate(None, FOLDS) == 2.0
+
+    def test_option_average_excludes_none(self):
+        assert OptMetric().calculate(None, FOLDS) == 3.0
+
+    def test_stdev(self):
+        assert SMetric().calculate(None, FOLDS) == pytest.approx(1.632993, rel=1e-5)
+
+    def test_sum(self):
+        assert SumQ().calculate(None, FOLDS) == 6.0
+
+    def test_zero(self):
+        assert ZeroMetric().calculate(None, FOLDS) == 0.0
+
+    def test_compare_larger_better(self):
+        m = QMetric()
+        assert m.compare(2.0, 1.0) > 0
+        assert m.compare(1.0, 2.0) < 0
+        assert m.compare(1.0, 1.0) == 0
+
+
+def ep(algo_id, ds_id=3):
+    return EngineParams(
+        data_source_params=DSParams(id=ds_id),
+        preparator_params=PrepParams(id=5),
+        algorithm_params_list=[("sample", AlgoParams(algo_id))],
+        serving_params=None,
+    )
+
+
+class BestAlgoId(AverageMetric):
+    """Scores a candidate by its model's algo id (deterministic ranking)."""
+
+    def calculate_one(self, q, p, a):
+        return float(p.models[0][0])
+
+
+class TestMetricEvaluator:
+    def test_grid_search_picks_best(self, tmp_path):
+        engine = make_engine()
+        ctx = MeshContext.create()
+        evaluator = MetricEvaluator(BestAlgoId())
+        out = tmp_path / "best.json"
+        result = evaluator.evaluate_base(
+            ctx, engine, [ep(1), ep(9), ep(4)], output_path=str(out)
+        )
+        assert result.best.score == 9.0
+        assert result.best.engine_params.algorithm_params_list[0][1].id == 9
+        saved = json.loads(out.read_text())
+        assert saved["bestScore"] == 9.0
+        assert saved["bestEngineParams"]["algorithmParamsList"][0]["params"]["id"] == 9
+        assert len(saved["results"]) == 3
+
+    def test_fast_eval_cache_memoizes_stages(self):
+        engine = make_engine()
+        ctx = MeshContext.create()
+        cache = FastEvalCache(engine, ctx)
+        f1 = cache.folds(DSParams(id=3))
+        f2 = cache.folds(DSParams(id=3))
+        assert f1 is f2  # same params prefix → cached
+        assert cache.folds(DSParams(id=4)) is not f1
+        m1 = cache.models(DSParams(id=3), PrepParams(id=5), [("sample", AlgoParams(1))])
+        m2 = cache.models(DSParams(id=3), PrepParams(id=5), [("sample", AlgoParams(1))])
+        assert m1 is m2
+        assert len(cache._prepared) == 1  # prepare ran once for the shared prefix
+
+
+class SampleEvaluation:
+    """Module-level Evaluation+Generator for run_evaluation reflection."""
+
+    def __init__(self):
+        self.engine = make_engine()
+        self.metric = BestAlgoId()
+        self.metrics = None
+        self.engine_params_list = [ep(2), ep(7)]
+
+    @property
+    def all_metrics(self):
+        return [self.metric]
+
+
+class TestRunEvaluation:
+    def test_writes_evaluation_instance(self, storage):
+        result = run_evaluation(
+            "test_evaluation.SampleEvaluation", storage=storage
+        )
+        assert result.best_score == 7.0
+        inst = storage.get_meta_data_evaluation_instances().get(result.instance_id)
+        assert inst.status == "EVALCOMPLETED"
+        assert "best score: 7.0" in inst.evaluator_results
+        assert json.loads(inst.evaluator_results_json)["bestScore"] == 7.0
+        assert storage.get_meta_data_evaluation_instances().get_completed()
+
+
+class TestTemplateEvaluation:
+    def test_precision_at_k(self):
+        from predictionio_tpu.templates.recommendation import (
+            ItemScore,
+            PredictedResult,
+            PrecisionAtK,
+        )
+
+        m = PrecisionAtK(k=2)
+        pred = PredictedResult(
+            itemScores=[ItemScore("a", 1.0), ItemScore("b", 0.5)]
+        )
+        assert m.calculate_one(None, pred, ["a", "z"]) == 0.5
+        assert m.calculate_one(None, PredictedResult(itemScores=[]), ["a"]) is None
+        assert m.header == "Precision@2"

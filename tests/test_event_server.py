@@ -1,0 +1,259 @@
+"""Event server REST tests over a live HTTP socket.
+
+Parity model: data/.../api/EventServiceSpec.scala + the tier-3 eventserver
+scenario fixtures (batch limit 50 boundary, partially-malformed batches;
+SURVEY.md §4).
+"""
+
+import base64
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.api.event_server import EventServer
+from predictionio_tpu.data.storage import AccessKey, App, Channel
+
+
+@pytest.fixture()
+def server(storage):
+    app_id = storage.get_meta_data_apps().insert(App(0, "srvapp"))
+    key = storage.get_meta_data_access_keys().insert(AccessKey("", app_id, []))
+    limited = storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ["rate"])
+    )
+    chan_id = storage.get_meta_data_channels().insert(Channel(0, "live", app_id))
+    es = EventServer(storage=storage, stats=True)
+    port = es.start(host="127.0.0.1", port=0)
+    yield {
+        "base": f"http://127.0.0.1:{port}",
+        "key": key,
+        "limited": limited,
+        "app_id": app_id,
+        "chan_id": chan_id,
+        "storage": storage,
+    }
+    es.stop()
+
+
+def call(method, url, body=None, headers=None):
+    data = None
+    if body is not None:
+        data = json.dumps(body).encode() if not isinstance(body, (str, bytes)) else (
+            body.encode() if isinstance(body, str) else body
+        )
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+EV = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 5},
+}
+
+
+class TestEventAPI:
+    def test_alive(self, server):
+        status, body = call("GET", server["base"] + "/")
+        assert (status, body) == (200, {"status": "alive"})
+
+    def test_auth_required_and_invalid(self, server):
+        status, body = call("POST", server["base"] + "/events.json", EV)
+        assert status == 401 and "Missing" in body["message"]
+        status, _ = call(
+            "POST", server["base"] + "/events.json?accessKey=WRONG", EV
+        )
+        assert status == 401
+
+    def test_basic_auth_header(self, server):
+        creds = base64.b64encode(f"{server['key']}:".encode()).decode()
+        status, body = call(
+            "POST",
+            server["base"] + "/events.json",
+            EV,
+            headers={"Authorization": f"Basic {creds}"},
+        )
+        assert status == 201 and body["eventId"]
+
+    def test_create_get_delete_roundtrip(self, server):
+        url = server["base"] + f"/events.json?accessKey={server['key']}"
+        status, body = call("POST", url, EV)
+        assert status == 201
+        eid = body["eventId"]
+        status, got = call(
+            "GET", server["base"] + f"/events/{eid}.json?accessKey={server['key']}"
+        )
+        assert status == 200 and got["event"] == "rate" and got["eventId"] == eid
+        status, _ = call(
+            "DELETE", server["base"] + f"/events/{eid}.json?accessKey={server['key']}"
+        )
+        assert status == 200
+        status, _ = call(
+            "GET", server["base"] + f"/events/{eid}.json?accessKey={server['key']}"
+        )
+        assert status == 404
+
+    def test_malformed_event_400(self, server):
+        url = server["base"] + f"/events.json?accessKey={server['key']}"
+        bad = dict(EV)
+        del bad["entityId"]
+        status, body = call("POST", url, bad)
+        assert status == 400
+
+    def test_event_whitelist(self, server):
+        url = server["base"] + f"/events.json?accessKey={server['limited']}"
+        status, _ = call("POST", url, EV)  # rate allowed
+        assert status == 201
+        buy = dict(EV, event="buy")
+        status, body = call("POST", url, buy)
+        assert status == 403 and "not allowed" in body["message"]
+
+    def test_find_with_filters(self, server):
+        url = server["base"] + f"/events.json?accessKey={server['key']}"
+        for i in range(3):
+            call("POST", url, dict(EV, entityId=f"uf{i}"))
+        call("POST", url, dict(EV, event="buy", entityId="uf0"))
+        status, events = call(
+            "GET",
+            server["base"]
+            + f"/events.json?accessKey={server['key']}&event=buy&limit=10",
+        )
+        assert status == 200
+        assert all(e["event"] == "buy" for e in events)
+        status, events = call(
+            "GET",
+            server["base"]
+            + f"/events.json?accessKey={server['key']}&entityId=uf1",
+        )
+        assert status == 200 and len(events) == 1
+        status, _ = call(
+            "GET",
+            server["base"]
+            + f"/events.json?accessKey={server['key']}&entityId=nonexistent",
+        )
+        assert status == 404
+
+    def test_channel_isolation(self, server):
+        base, key = server["base"], server["key"]
+        call("POST", base + f"/events.json?accessKey={key}&channel=live",
+             dict(EV, entityId="chan-user"))
+        status, _ = call(
+            "GET", base + f"/events.json?accessKey={key}&entityId=chan-user"
+        )
+        assert status == 404  # not on default channel
+        status, events = call(
+            "GET",
+            base + f"/events.json?accessKey={key}&channel=live&entityId=chan-user",
+        )
+        assert status == 200 and len(events) == 1
+        status, body = call(
+            "POST", base + f"/events.json?accessKey={key}&channel=nope", EV
+        )
+        assert status == 400 and "channel" in body["message"].lower()
+
+
+class TestBatch:
+    def test_batch_partial_success(self, server):
+        url = server["base"] + f"/batch/events.json?accessKey={server['key']}"
+        batch = [EV, {"event": "", "entityType": "u", "entityId": "x"}, EV]
+        status, results = call("POST", url, batch)
+        assert status == 200
+        assert [r["status"] for r in results] == [201, 400, 201]
+        assert "eventId" in results[0] and "message" in results[1]
+
+    def test_batch_limit_50(self, server):
+        url = server["base"] + f"/batch/events.json?accessKey={server['key']}"
+        status, results = call("POST", url, [EV] * 50)
+        assert status == 200 and len(results) == 50
+        status, body = call("POST", url, [EV] * 51)
+        assert status == 400 and "50" in body["message"]
+
+
+class TestStats:
+    def test_stats_counts(self, server):
+        url = server["base"] + f"/events.json?accessKey={server['key']}"
+        call("POST", url, dict(EV, entityId="stat1"))
+        call("POST", url, {"event": "", "entityType": "u", "entityId": "x"})
+        status, stats = call(
+            "GET", server["base"] + f"/stats.json?accessKey={server['key']}"
+        )
+        assert status == 200
+        counts = {(c["event"], c["status"]): c["count"] for c in stats["statusCount"]}
+        assert counts[("rate", 201)] >= 1
+        assert counts[("", 400)] >= 1
+
+
+class TestWebhooks:
+    def test_segmentio_track(self, server):
+        url = server["base"] + f"/webhooks/segmentio.json?accessKey={server['key']}"
+        payload = {
+            "type": "track",
+            "userId": "seg-user",
+            "event": "Clicked",
+            "properties": {"plan": "pro"},
+            "timestamp": "2026-01-02T03:04:05Z",
+        }
+        status, body = call("POST", url, payload)
+        assert status == 201 and body["eventId"]
+        status, events = call(
+            "GET",
+            server["base"]
+            + f"/events.json?accessKey={server['key']}&entityId=seg-user",
+        )
+        assert events[0]["event"] == "track"
+        assert events[0]["properties"]["plan"] == "pro"
+        assert events[0]["eventTime"].startswith("2026-01-02T03:04:05")
+
+    def test_segmentio_unsupported_type(self, server):
+        url = server["base"] + f"/webhooks/segmentio.json?accessKey={server['key']}"
+        status, body = call("POST", url, {"type": "nope", "userId": "u"})
+        assert status == 400
+
+    def test_unknown_connector_404_and_probe(self, server):
+        key = server["key"]
+        status, _ = call(
+            "POST", server["base"] + f"/webhooks/zzz.json?accessKey={key}", {}
+        )
+        assert status == 404
+        status, _ = call(
+            "GET", server["base"] + f"/webhooks/segmentio.json?accessKey={key}"
+        )
+        assert status == 200
+
+    def test_mailchimp_form(self, server):
+        form = urllib.parse.urlencode(
+            {
+                "type": "subscribe",
+                "fired_at": "2026-01-02 03:04:05",
+                "data[email]": "a@b.com",
+                "data[list_id]": "L1",
+            }
+        )
+        req = urllib.request.Request(
+            server["base"] + f"/webhooks/mailchimp.form?accessKey={server['key']}",
+            data=form.encode(),
+            method="POST",
+        )
+        req.add_header("Content-Type", "application/x-www-form-urlencoded")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 201
+        status, events = call(
+            "GET",
+            server["base"]
+            + f"/events.json?accessKey={server['key']}&entityId=a@b.com",
+        )
+        assert events[0]["event"] == "subscribe"
+        assert events[0]["properties"]["list_id"] == "L1"

@@ -1,0 +1,211 @@
+"""Query server + batch predict over live HTTP with the recommendation engine.
+
+Parity model: the quickstart tier-3 scenario's deploy/query/undeploy phase +
+CreateServer route behavior (SURVEY.md §3.2).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.data import Event
+from predictionio_tpu.data import store as store_mod
+from predictionio_tpu.data.storage import AccessKey, App
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.serving.batch_predict import run_batch_predict
+from predictionio_tpu.serving.query_server import EngineServerPlugin, QueryServer
+from predictionio_tpu.templates.recommendation import RecommendationEngine
+
+
+def call(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+@pytest.fixture()
+def trained(storage):
+    store_mod.set_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(App(0, "qsapp"))
+    le = storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(3)
+    events = []
+    for u in range(20):
+        for i in rng.choice(16, size=6, replace=False):
+            events.append(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties={"rating": float(rng.integers(1, 6))},
+                )
+            )
+    le.batch_insert(events, app_id)
+    engine = RecommendationEngine.apply()
+    ep = engine.params_from_variant(
+        {
+            "datasource": {"params": {"appName": "qsapp"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 3}}
+            ],
+        }
+    )
+    ctx = MeshContext.create()
+    run_train(engine, ep, "f", storage=storage, ctx=ctx)
+    yield {"storage": storage, "engine": engine, "ctx": ctx, "ep": ep}
+    store_mod.set_storage(None)
+
+
+class UpperCasePlugin(EngineServerPlugin):
+    name = "upper"
+    plugin_type = EngineServerPlugin.OUTPUT_BLOCKER
+
+    def process(self, query, prediction, context):
+        prediction["itemScores"] = prediction["itemScores"][:1]
+        return prediction
+
+
+class TestQueryServer:
+    def test_query_info_reload_stop(self, trained):
+        qs = QueryServer(
+            trained["engine"], storage=trained["storage"], ctx=trained["ctx"]
+        )
+        port = qs.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            status, res = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200 and len(res["itemScores"]) == 3
+
+            # unknown JSON fields are ignored (lenient query binding)
+            status, res = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 2, "zzz": 1}
+            )
+            assert status == 200 and len(res["itemScores"]) == 2
+
+            status, info = call("GET", base + "/")
+            assert info["requestCount"] == 2 and info["engineInstanceId"]
+            first_iid = info["engineInstanceId"]
+
+            # retrain → /reload picks up the NEW instance
+            run_train(
+                trained["engine"], trained["ep"], "f",
+                storage=trained["storage"], ctx=trained["ctx"],
+            )
+            status, body = call("GET", base + "/reload")
+            assert status == 200 and body["engineInstanceId"] != first_iid
+
+            status, res = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 1}
+            )
+            assert status == 200  # serving continued across reload
+        finally:
+            status, body = call("POST", base + "/stop")
+            assert "Shutting down" in body["message"]
+            time.sleep(0.2)
+            with pytest.raises(Exception):
+                call("GET", base + "/")
+
+    def test_output_blocker_plugin_and_plugins_route(self, trained):
+        qs = QueryServer(
+            trained["engine"],
+            storage=trained["storage"],
+            ctx=trained["ctx"],
+            plugins=[UpperCasePlugin()],
+        )
+        port = qs.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            status, res = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 5}
+            )
+            assert len(res["itemScores"]) == 1  # blocker rewrote the output
+            status, plugins = call("GET", base + "/plugins.json")
+            assert "upper" in plugins["plugins"]["outputblockers"]
+        finally:
+            qs.stop()
+
+    def test_feedback_loop_posts_to_event_server(self, trained):
+        from predictionio_tpu.data.api.event_server import EventServer
+
+        storage = trained["storage"]
+        key = storage.get_meta_data_access_keys().insert(
+            AccessKey("", storage.get_meta_data_apps().get_by_name("qsapp").id, [])
+        )
+        es = EventServer(storage=storage)
+        es_port = es.start("127.0.0.1", 0)
+        qs = QueryServer(
+            trained["engine"],
+            storage=storage,
+            ctx=trained["ctx"],
+            feedback=True,
+            event_server_url=f"http://127.0.0.1:{es_port}",
+            access_key=key,
+        )
+        port = qs.start("127.0.0.1", 0)
+        try:
+            status, res = call(
+                "POST",
+                f"http://127.0.0.1:{port}/queries.json",
+                {"user": "u2", "num": 2},
+            )
+            assert "prId" in res
+            deadline = time.time() + 5
+            feedback_events = []
+            while time.time() < deadline and not feedback_events:
+                feedback_events = list(
+                    storage.get_l_events().find(
+                        storage.get_meta_data_apps().get_by_name("qsapp").id,
+                        event_names=["predict"],
+                    )
+                )
+                time.sleep(0.05)
+            assert feedback_events, "feedback event never arrived"
+            props = feedback_events[0].properties
+            assert props["prediction"]["prId"] == res["prId"]
+        finally:
+            qs.stop()
+            es.stop()
+
+
+class TestBatchPredict:
+    def test_batch_predict_file(self, trained, tmp_path):
+        inp = tmp_path / "queries.json"
+        out = tmp_path / "out.json"
+        inp.write_text(
+            "\n".join(
+                [
+                    json.dumps({"user": "u1", "num": 2}),
+                    "",
+                    json.dumps({"user": "u2", "num": 1}),
+                    "not-json",
+                ]
+            )
+        )
+        n = run_batch_predict(
+            trained["engine"],
+            str(inp),
+            str(out),
+            storage=trained["storage"],
+            ctx=trained["ctx"],
+        )
+        assert n == 2
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        assert len(lines) == 3  # 2 ok + 1 error line
+        assert len(lines[0]["prediction"]["itemScores"]) == 2
+        assert "error" in lines[2]

@@ -1,0 +1,213 @@
+"""CLI / admin server / dashboard / export-import tests.
+
+Parity model: tools tests (RunnerSpec, AdminAPISpec) + tier-3
+basic_app_usecases scenario (app/accesskey CRUD via the operator surface).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.tools.cli import main
+
+
+@pytest.fixture()
+def cli_env(mem_env, monkeypatch):
+    """Point the process-global Storage at the test memory source."""
+    for k, v in mem_env.items():
+        monkeypatch.setenv(k, v)
+    Storage.reset_instance()
+    yield mem_env
+    Storage.reset_instance()
+
+
+def run_cli(*argv):
+    return main(list(argv))
+
+
+class TestCliAppCommands:
+    def test_app_lifecycle(self, cli_env, capsys):
+        assert run_cli("app", "new", "myapp") == 0
+        out = capsys.readouterr().out
+        assert "App created" in out and "Access Key:" in out
+
+        assert run_cli("app", "new", "myapp") == 1  # duplicate
+
+        assert run_cli("app", "list") == 0
+        assert "myapp" in capsys.readouterr().out
+
+        assert run_cli("app", "show", "myapp") == 0
+        assert "Access Key" in capsys.readouterr().out
+
+        assert run_cli("app", "channel-new", "myapp", "live") == 0
+        capsys.readouterr()
+        assert run_cli("app", "channel-delete", "myapp", "live") == 0
+        capsys.readouterr()
+        assert run_cli("app", "data-delete", "myapp") == 0
+        capsys.readouterr()
+        assert run_cli("app", "delete", "myapp") == 0
+        assert run_cli("app", "show", "myapp") == 1
+
+    def test_accesskey_commands(self, cli_env, capsys):
+        run_cli("app", "new", "akapp")
+        capsys.readouterr()
+        assert run_cli("accesskey", "new", "akapp", "rate", "buy") == 0
+        key = capsys.readouterr().out.strip().split()[-1]
+        assert run_cli("accesskey", "list") == 0
+        assert key in capsys.readouterr().out
+        assert run_cli("accesskey", "delete", key) == 0
+
+    def test_status(self, cli_env, capsys):
+        assert run_cli("status") == 0
+        assert "ready to go" in capsys.readouterr().out
+
+    def test_version(self, cli_env, capsys):
+        assert run_cli("version") == 0
+
+
+class TestCliTrainDeployFlow:
+    def test_build_train_batchpredict(self, cli_env, tmp_path, capsys):
+        import numpy as np
+
+        run_cli("app", "new", "flowapp")
+        capsys.readouterr()
+        storage = Storage.instance()
+        app = storage.get_meta_data_apps().get_by_name("flowapp")
+        rng = np.random.default_rng(0)
+        le = storage.get_l_events()
+        events = [
+            Event(
+                event="rate",
+                entity_type="user",
+                entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{rng.integers(0, 10)}",
+                properties={"rating": float(rng.integers(1, 6))},
+            )
+            for u in range(15)
+            for _ in range(4)
+        ]
+        le.batch_insert(events, app.id)
+
+        variant = {
+            "id": "default",
+            "engineFactory": "predictionio_tpu.templates.recommendation.RecommendationEngine",
+            "datasource": {"params": {"appName": "flowapp"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 2}}
+            ],
+        }
+        vpath = tmp_path / "engine.json"
+        vpath.write_text(json.dumps(variant))
+
+        assert run_cli("build", "--variant", str(vpath)) == 0
+        capsys.readouterr()
+        assert run_cli("train", "--variant", str(vpath)) == 0
+        assert "Training completed" in capsys.readouterr().out
+
+        qfile = tmp_path / "q.json"
+        qfile.write_text(json.dumps({"user": "u1", "num": 3}) + "\n")
+        ofile = tmp_path / "o.json"
+        assert (
+            run_cli(
+                "batchpredict",
+                "--variant", str(vpath),
+                "--input", str(qfile),
+                "--output", str(ofile),
+            )
+            == 0
+        )
+        pred = json.loads(ofile.read_text().splitlines()[0])
+        assert len(pred["prediction"]["itemScores"]) == 3
+
+    def test_train_missing_variant_fails_cleanly(self, cli_env, tmp_path, capsys):
+        assert run_cli("train", "--variant", str(tmp_path / "nope.json")) == 1
+        assert "not found" in capsys.readouterr().err
+
+
+class TestExportImport:
+    def test_roundtrip(self, cli_env, tmp_path, capsys):
+        storage = Storage.instance()
+        app_id = storage.get_meta_data_apps().insert(App(0, "exapp"))
+        le = storage.get_l_events()
+        le.init(app_id)
+        le.insert(
+            Event(event="buy", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1"),
+            app_id,
+        )
+        out = tmp_path / "events.jsonl"
+        assert run_cli("export", "--appid", str(app_id), "--output", str(out)) == 0
+        assert "Exported 1 events" in capsys.readouterr().out
+
+        app2 = storage.get_meta_data_apps().insert(App(0, "exapp2"))
+        assert run_cli("import", "--appid", str(app2), "--input", str(out)) == 0
+        imported = list(le.find(app2))
+        assert len(imported) == 1 and imported[0].event == "buy"
+
+
+def http(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestAdminServer:
+    def test_app_routes(self, storage):
+        from predictionio_tpu.tools.admin import AdminServer
+
+        server = AdminServer(storage=storage)
+        port = server.start(port=0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            status, body = http("GET", base + "/")
+            assert status == 200 and json.loads(body)["status"] == "alive"
+            status, body = http("POST", base + "/cmd/app", {"name": "adm"})
+            assert status == 201 and json.loads(body)["accessKey"]
+            status, body = http("GET", base + "/cmd/app")
+            assert [a["name"] for a in json.loads(body)] == ["adm"]
+            status, _ = http("DELETE", base + "/cmd/app/adm/data")
+            assert status == 200
+            status, _ = http("DELETE", base + "/cmd/app/adm")
+            assert status == 200
+            status, body = http("GET", base + "/cmd/app")
+            assert json.loads(body) == []
+        finally:
+            server.stop()
+
+
+class TestDashboard:
+    def test_lists_completed_evaluations(self, storage):
+        from predictionio_tpu.core.evaluation import run_evaluation
+        from predictionio_tpu.tools.dashboard import Dashboard
+
+        result = run_evaluation("test_evaluation.SampleEvaluation", storage=storage)
+        server = Dashboard(storage=storage)
+        port = server.start(port=0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            status, body = http("GET", base + "/")
+            assert status == 200 and result.instance_id in body
+            status, body = http(
+                "GET",
+                base + f"/engine_instances/{result.instance_id}/evaluator_results.json",
+            )
+            assert status == 200 and json.loads(body)["bestScore"] == 7.0
+            status, body = http(
+                "GET",
+                base + f"/engine_instances/{result.instance_id}/evaluator_results.txt",
+            )
+            assert status == 200 and "best score" in body
+        finally:
+            server.stop()
