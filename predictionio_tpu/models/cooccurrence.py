@@ -1,0 +1,148 @@
+"""Item co-occurrence / CCO: top-N similar items from interaction overlap.
+
+Capability parity with ``examples/scala-parallel-similarproduct/
+multi-events-multi-algos/src/main/scala/CooccurrenceAlgorithm.scala:45-140``
+(user-item self-join → per-pair counts → top-N per item) and, via
+:func:`llr_scores`, the log-likelihood-ratio scoring at the heart of CCO /
+Universal Recommender.
+
+TPU-first design: the reference's RDD self-join is a shuffle of all
+(item, item) pairs per user.  Here the user×item incidence matrix is built
+densely in user blocks and the co-occurrence matrix is accumulated as
+``C = Σ_blocks A_bᵀ A_b`` — a chain of MXU matmuls under ``lax.scan``, no
+pair explosion.  Top-N per row via ``lax.top_k``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.data.batch import Interactions
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.parallel.mesh import MeshContext, pad_to_multiple
+
+_USER_BLOCK = 4096  # users per matmul block (A_b is USER_BLOCK × n_items)
+
+
+@dataclasses.dataclass
+class CooccurrenceModel:
+    top_items: np.ndarray  # (n_items, N) int32 similar-item indices
+    top_scores: np.ndarray  # (n_items, N) float32
+    item_map: BiMap
+
+    def similar(self, item_idx: int, n: int) -> tuple[np.ndarray, np.ndarray]:
+        idx = self.top_items[item_idx][:n]
+        sc = self.top_scores[item_idx][:n]
+        keep = sc > 0
+        return idx[keep], sc[keep]
+
+
+def cooccurrence_matrix(ctx: MeshContext, interactions: Interactions) -> jnp.ndarray:
+    """Dense (n_items, n_items) co-occurrence counts (diagonal = item counts)."""
+    n_users = interactions.n_users
+    n_items = interactions.n_items
+    n_items_pad = pad_to_multiple(n_items, 128)  # lane-aligned for the MXU
+    n_users_pad = pad_to_multiple(n_users, _USER_BLOCK)
+    # binary incidence built on host block-by-block is memory-hungry; build
+    # sparse→dense per block on device instead via scatter
+    n_blocks = n_users_pad // _USER_BLOCK
+
+    order = np.argsort(interactions.user, kind="stable")
+    u = interactions.user[order].astype(np.int64)
+    i = interactions.item[order].astype(np.int64)
+
+    # row pointer per block
+    block_of = u // _USER_BLOCK
+    counts = np.bincount(block_of, minlength=n_blocks)
+    max_per_block = pad_to_multiple(int(counts.max()) if len(counts) else 1, 8)
+    lu = np.zeros((n_blocks, max_per_block), np.int32)
+    li = np.zeros((n_blocks, max_per_block), np.int32)
+    lm = np.zeros((n_blocks, max_per_block), np.float32)
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    for b in range(n_blocks):
+        s, e = offsets[b], offsets[b + 1]
+        n = e - s
+        lu[b, :n] = (u[s:e] - b * _USER_BLOCK).astype(np.int32)
+        li[b, :n] = i[s:e].astype(np.int32)
+        lm[b, :n] = 1.0
+
+    @jax.jit
+    def accumulate(lu, li, lm):
+        def body(C, xs):
+            bu, bi, bm = xs
+            A = jnp.zeros((_USER_BLOCK, n_items_pad), jnp.bfloat16)
+            A = A.at[bu, bi].max(bm.astype(jnp.bfloat16))  # binary incidence
+            C = C + jnp.dot(
+                A.T, A, preferred_element_type=jnp.float32
+            )  # MXU matmul
+            return C, None
+
+        C0 = jnp.zeros((n_items_pad, n_items_pad), jnp.float32)
+        C, _ = jax.lax.scan(body, C0, (lu, li, lm))
+        return C
+
+    C = accumulate(jnp.asarray(lu), jnp.asarray(li), jnp.asarray(lm))
+    return C[:n_items, :n_items]
+
+
+def llr_scores(C: jnp.ndarray, n_users: Optional[int] = None) -> jnp.ndarray:
+    """Log-likelihood-ratio rescoring of a co-occurrence matrix (CCO/UR).
+
+    Contingency per pair over the USER population (Mahout/CCO convention):
+    k11 = C_ij, k12 = count_i - C_ij, k21 = count_j - C_ij,
+    k22 = n_users - count_i - count_j + C_ij.
+    Pass ``n_users``; without it the interaction total is a (biased) stand-in.
+    """
+    diag = jnp.diag(C)
+    total = jnp.maximum(
+        jnp.float32(n_users) if n_users is not None else diag.sum(), 1.0
+    )
+
+    k11 = C
+    k12 = jnp.maximum(diag[:, None] - C, 0.0)
+    k21 = jnp.maximum(diag[None, :] - C, 0.0)
+    k22 = jnp.maximum(total - diag[:, None] - diag[None, :] + C, 0.0)
+
+    def xlogx(x):
+        return jnp.where(x > 0, x * jnp.log(x), 0.0)
+
+    def entropy(*ks):
+        s = sum(ks)
+        return xlogx(s) - sum(xlogx(k) for k in ks)
+
+    h_matrix = entropy(k11, k12, k21, k22)
+    h_rows = entropy(k11 + k12, k21 + k22)
+    h_cols = entropy(k11 + k21, k12 + k22)
+    # Dunning's G²: 2·(rowEntropy + colEntropy − matrixEntropy), floored at 0
+    llr = 2.0 * jnp.maximum(h_rows + h_cols - h_matrix, 0.0)
+    return jnp.where(C > 0, llr, 0.0)
+
+
+def train_cooccurrence(
+    ctx: MeshContext,
+    interactions: Interactions,
+    n: int = 20,
+    use_llr: bool = False,
+) -> CooccurrenceModel:
+    C = cooccurrence_matrix(ctx, interactions)
+    scores = llr_scores(C, n_users=interactions.n_users) if use_llr else C
+    n_items = scores.shape[0]
+    scores = scores - jnp.diag(jnp.diag(scores))  # exclude self-pairs
+    k = min(n, n_items)
+
+    @partial(jax.jit, static_argnums=(1,))
+    def topn(S, k):
+        return jax.lax.top_k(S, k)
+
+    vals, idx = topn(scores, k)
+    return CooccurrenceModel(
+        top_items=np.asarray(idx, np.int32),
+        top_scores=np.asarray(vals, np.float32),
+        item_map=interactions.item_map,
+    )

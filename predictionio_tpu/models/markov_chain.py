@@ -1,0 +1,58 @@
+"""First-order Markov chain: top-N transition model.
+
+Parity: ``e2/.../engine/MarkovChain.scala:25-87`` (transition counts from a
+``CoordinateMatrix`` → row-normalized top-N successors per state).  Here the
+counts are one scatter-add over (from·S + to) flat indices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.ops.segment import segment_sum
+
+
+@dataclasses.dataclass
+class MarkovChainModel:
+    top_states: np.ndarray  # (S, N) successor state indices
+    top_probs: np.ndarray  # (S, N) transition probabilities
+
+    def transition(self, state: int, n: int | None = None):
+        idx = self.top_states[state]
+        p = self.top_probs[state]
+        keep = p > 0
+        idx, p = idx[keep], p[keep]
+        return (idx[:n], p[:n]) if n is not None else (idx, p)
+
+
+def train_markov_chain(
+    ctx, from_states: np.ndarray, to_states: np.ndarray, n_states: int, top_n: int = 10
+) -> MarkovChainModel:
+    if n_states * n_states >= 2**31:
+        # flat (from, to) ids must fit int32 (jax default int width)
+        raise ValueError(
+            f"n_states={n_states} needs {n_states * n_states} transition "
+            "cells, exceeding int32 indexing; shard the state space first"
+        )
+    flat = from_states.astype(np.int64) * n_states + to_states.astype(np.int64)
+    counts = np.asarray(
+        segment_sum(
+            jnp.ones(len(flat), jnp.float32),
+            jnp.asarray(flat.astype(np.int32)),
+            n_states * n_states,
+        )
+    ).reshape(n_states, n_states)
+    row_sums = counts.sum(axis=1, keepdims=True)
+    probs = np.divide(
+        counts, row_sums, out=np.zeros_like(counts), where=row_sums > 0
+    )
+    import jax
+
+    k = min(top_n, n_states)
+    vals, idx = jax.lax.top_k(jnp.asarray(probs), k)
+    return MarkovChainModel(
+        top_states=np.asarray(idx, np.int32), top_probs=np.asarray(vals, np.float32)
+    )

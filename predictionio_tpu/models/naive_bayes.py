@@ -1,0 +1,149 @@
+"""Naive Bayes classifiers: multinomial (numeric vectors) + categorical.
+
+Capability parity with the two NB flavors the reference uses:
+
+* MLlib ``NaiveBayes.train`` over double-feature vectors — the
+  classification template's algorithm
+  (``examples/scala-parallel-classification/.../NaiveBayesAlgorithm.scala``).
+* ``e2/.../engine/CategoricalNaiveBayes.scala:23-172`` — NB over
+  string-feature vectors with add-one smoothing and ``logScore``.
+
+TPU-first design: class-conditional statistics are ``segment_sum``s keyed by
+label (no RDD aggregate); categorical features are BiMap-indexed integers and
+counts come from one scatter-add per feature.  Scoring is a single matmul
+(multinomial) or gathered table lookups (categorical).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.ops.segment import segment_sum
+
+
+# -- multinomial NB (MLlib NaiveBayes parity) --------------------------------
+
+
+@dataclasses.dataclass
+class MultinomialNBModel:
+    log_prior: np.ndarray  # (C,)
+    log_theta: np.ndarray  # (C, F)
+    label_map: BiMap  # label string ↔ class index
+
+    def predict_scores(self, x: np.ndarray) -> np.ndarray:
+        """(..., F) → (..., C) joint log-likelihoods."""
+        return x @ self.log_theta.T + self.log_prior
+
+    def predict(self, x: np.ndarray) -> str:
+        idx = int(np.argmax(self.predict_scores(np.asarray(x, np.float32))))
+        return self.label_map.inverse[idx]
+
+
+def train_multinomial_nb(
+    ctx,
+    features: np.ndarray,  # (N, F) non-negative
+    labels: Sequence,  # N label values (any hashable)
+    smoothing: float = 1.0,
+) -> MultinomialNBModel:
+    label_map = BiMap.string_int([str(l) for l in labels])
+    y = label_map.to_index_array([str(l) for l in labels])
+    n_classes = len(label_map)
+    x = jnp.asarray(np.asarray(features, np.float32))
+    yj = jnp.asarray(y.astype(np.int32))
+    class_counts = segment_sum(jnp.ones(len(y), jnp.float32), yj, n_classes)
+    feat_sums = segment_sum(x, yj, n_classes)  # (C, F)
+    log_prior = jnp.log(class_counts / class_counts.sum())
+    num = feat_sums + smoothing
+    log_theta = jnp.log(num / num.sum(axis=1, keepdims=True))
+    return MultinomialNBModel(
+        log_prior=np.asarray(log_prior),
+        log_theta=np.asarray(log_theta),
+        label_map=label_map,
+    )
+
+
+# -- categorical NB (e2 CategoricalNaiveBayes parity) ------------------------
+
+
+@dataclasses.dataclass
+class CategoricalNBModel:
+    """Per-feature value tables of log P(value | class) + log priors.
+
+    Parity: CategoricalNaiveBayes.scala model (priors + likelihoods maps);
+    unseen values score a configurable default (``log_score`` default_likelihood
+    hook, CategoricalNaiveBayes.scala:~120).
+    """
+
+    log_prior: np.ndarray  # (C,)
+    log_likelihood: list[np.ndarray]  # per feature f: (C, V_f)
+    label_map: BiMap
+    value_maps: list[BiMap]
+
+    def log_score(
+        self, features: Sequence[str], default_likelihood: float = float("-inf")
+    ) -> Optional[np.ndarray]:
+        """(C,) joint log scores, or None if a value is unseen and default=-inf."""
+        scores = self.log_prior.copy()
+        for f, value in enumerate(features):
+            vi = self.value_maps[f].get(value)
+            if vi is None:
+                if default_likelihood == float("-inf"):
+                    return None
+                scores = scores + default_likelihood
+            else:
+                scores = scores + self.log_likelihood[f][:, vi]
+        return scores
+
+    def predict(self, features: Sequence[str]) -> str:
+        scores = self.log_score(features, default_likelihood=-20.0)
+        return self.label_map.inverse[int(np.argmax(scores))]
+
+
+def train_categorical_nb(
+    ctx, points: Sequence[tuple[str, Sequence[str]]]
+) -> CategoricalNBModel:
+    """points: (label, [feature values]) — all rows same feature count."""
+    labels = [l for l, _ in points]
+    label_map = BiMap.string_int(labels)
+    y = label_map.to_index_array(labels).astype(np.int32)
+    n_classes = len(label_map)
+    n_features = len(points[0][1]) if points else 0
+    value_maps: list[BiMap] = []
+    tables: list[np.ndarray] = []
+    yj = jnp.asarray(y)
+    class_counts = np.asarray(
+        segment_sum(jnp.ones(len(y), jnp.float32), yj, n_classes)
+    )
+    for f in range(n_features):
+        col = [p[1][f] for p in points]
+        vmap = BiMap.string_int(col)
+        vi = vmap.to_index_array(col).astype(np.int64)
+        if n_classes * len(vmap) >= 2**31:
+            raise ValueError(
+                f"feature {f}: {n_classes}×{len(vmap)} count cells exceed "
+                "int32 indexing"
+            )
+        # joint index (class, value) → flat scatter-add, one pass per feature
+        flat = y.astype(np.int64) * len(vmap) + vi
+        counts = np.asarray(
+            segment_sum(
+                jnp.ones(len(flat), jnp.float32),
+                jnp.asarray(flat.astype(np.int32)),
+                n_classes * len(vmap),
+            )
+        ).reshape(n_classes, len(vmap))
+        smoothed = counts + 1.0  # add-one smoothing (reference default)
+        tables.append(np.log(smoothed / smoothed.sum(axis=1, keepdims=True)))
+        value_maps.append(vmap)
+    log_prior = np.log(class_counts / class_counts.sum())
+    return CategoricalNBModel(
+        log_prior=log_prior,
+        log_likelihood=tables,
+        label_map=label_map,
+        value_maps=value_maps,
+    )

@@ -1,0 +1,237 @@
+"""E-commerce recommendation template: implicit ALS + live business rules.
+
+Capability parity with ``examples/scala-parallel-ecommercerecommendation/``
+(``ECommAlgorithm.scala:85-560``):
+
+* train (``:91``): implicit ALS over view(+buy) events, plus
+  ``trainDefault`` (``:211``) — popular-interaction counts as the fallback
+  ranking for users unknown to the factor model.
+* predict (``:244``): business rules applied at serving time —
+  ``whiteList``/``blackList``/category filters, ``unseenOnly`` backed by a
+  **live** ``LEventStore.findByEntity`` read of the user's seen events
+  (``:332-360``), and the "unavailableItems" constraint entity read live per
+  query (the reference caches it the same way per request).
+* adjust-score variant: optional ``freshness``-style boost hook via
+  ``boostCategories``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    IdentityPreparator,
+    FirstServing,
+    Params,
+)
+from predictionio_tpu.core.controller import SanityCheck
+from predictionio_tpu.data.batch import Interactions
+from predictionio_tpu.data.store import LEventStore, PEventStore
+from predictionio_tpu.models.als import ALSConfig, ALSModel, train_als
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Query:
+    user: str
+    num: int = 10
+    categories: Optional[list[str]] = None
+    whiteList: Optional[list[str]] = None
+    blackList: Optional[list[str]] = None
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    itemScores: list[ItemScore]
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    interactions: Interactions
+    item_categories: dict
+
+    def sanity_check(self):
+        if len(self.interactions) == 0:
+            raise ValueError("No interaction events found; check appName.")
+
+
+PreparedData = TrainingData
+
+
+@dataclasses.dataclass
+class ECommDataSourceParams(Params):
+    appName: str = "default"
+    eventNames: tuple = ("view", "buy")
+
+
+class ECommDataSource(DataSource):
+    params_cls = ECommDataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        batch = PEventStore.find(
+            self.params.appName,
+            entity_type="user",
+            event_names=list(self.params.eventNames),
+            target_entity_type="item",
+        )
+        inter = batch.interactions(rating_key=None)
+        props = PEventStore.aggregate_properties(self.params.appName, "item")
+        item_categories = {
+            item_id: set(pm.get("categories") or []) for item_id, pm in props.items()
+        }
+        return TrainingData(interactions=inter, item_categories=item_categories)
+
+
+
+@dataclasses.dataclass
+class ECommAlgorithmParams(Params):
+    appName: str = "default"
+    unseenOnly: bool = False
+    seenEvents: tuple = ("view", "buy")
+    rank: int = 10
+    numIterations: int = 20
+    reg: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = None
+    boostCategories: Optional[dict] = None  # category → multiplier
+
+    json_aliases = {"lambda": "reg"}
+
+
+@dataclasses.dataclass
+class ECommModel:
+    als: ALSModel
+    popular: np.ndarray  # (n_items,) interaction counts (trainDefault)
+    item_categories: dict
+
+
+class ECommAlgorithm(Algorithm):
+    params_cls = ECommAlgorithmParams
+
+    def train(self, ctx, pd: PreparedData) -> ECommModel:
+        p = self.params
+        als = train_als(
+            ctx,
+            pd.interactions,
+            ALSConfig(
+                rank=p.rank,
+                iterations=p.numIterations,
+                reg=p.reg,
+                implicit=True,
+                alpha=p.alpha,
+                seed=3 if p.seed is None else p.seed,
+            ),
+        )
+        # trainDefault (ECommAlgorithm.scala:211): popular-count fallback
+        popular = np.bincount(
+            pd.interactions.item, minlength=len(als.item_map)
+        ).astype(np.float32)
+        return ECommModel(
+            als=als, popular=popular, item_categories=pd.item_categories
+        )
+
+    # -- live lookups (parity: predict-time LEventStore reads :332-360) -----
+    def _seen_items(self, user: str) -> set:
+        try:
+            events = LEventStore.find_by_entity(
+                self.params.appName,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.seenEvents),
+                target_entity_type="item",
+                limit=-1,
+            )
+            return {e.target_entity_id for e in events if e.target_entity_id}
+        except Exception:
+            logger.exception("seen-items lookup failed; continuing without")
+            return set()
+
+    def _unavailable_items(self) -> set:
+        try:
+            events = LEventStore.find_by_entity(
+                self.params.appName,
+                entity_type="constraint",
+                entity_id="unavailableItems",
+                event_names=["$set"],
+                limit=1,
+                latest=True,
+            )
+            if events:
+                return set(events[0].properties.get("items") or [])
+        except Exception:
+            logger.exception("unavailable-items lookup failed; continuing without")
+        return set()
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        item_map = model.als.item_map
+        user_idx = model.als.user_map.get(query.user)
+        if user_idx is not None:
+            scores = model.als.user_factors[user_idx] @ model.als.item_factors.T
+        else:
+            # unknown user → popularity fallback (predictDefault parity)
+            logger.info("user %s unknown; serving popular items", query.user)
+            scores = model.popular.copy()
+
+        # boosts rescale BEFORE ranking (adjust-score variant semantics)
+        boosts = self.params.boostCategories or {}
+        if boosts:
+            scores = scores.copy()
+            inv_all = item_map.inverse
+            for idx in range(len(scores)):
+                for c in model.item_categories.get(inv_all[idx], ()):
+                    if c in boosts:
+                        scores[idx] *= float(boosts[c])
+
+        excluded: set = set()
+        if query.blackList:
+            excluded |= set(query.blackList)
+        excluded |= self._unavailable_items()
+        if self.params.unseenOnly:
+            excluded |= self._seen_items(query.user)
+
+        white = set(query.whiteList) if query.whiteList else None
+        cats = set(query.categories) if query.categories else None
+
+        inv = item_map.inverse
+        results = []
+        for idx in np.argsort(-scores):
+            item_id = inv[int(idx)]
+            if item_id in excluded:
+                continue
+            if white is not None and item_id not in white:
+                continue
+            if cats is not None and not (
+                model.item_categories.get(item_id, set()) & cats
+            ):
+                continue
+            results.append(ItemScore(item_id, float(scores[idx])))
+            if len(results) >= query.num:
+                break
+        return PredictedResult(itemScores=results)
+
+
+class ECommerceEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source_cls=ECommDataSource,
+            preparator_cls=IdentityPreparator,
+            algorithm_cls_map={"ecomm": ECommAlgorithm},
+            serving_cls=FirstServing,
+            query_cls=Query,
+        )

@@ -27,9 +27,9 @@ from predictionio_tpu.core import (
     DataSource,
     Engine,
     EngineFactory,
+    IdentityPreparator,
     FirstServing,
     Params,
-    Preparator,
 )
 from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.core.evaluation import EngineParamsGenerator, Evaluation
@@ -314,13 +314,9 @@ class RecommendationEngine(EngineFactory):
     def apply(cls) -> Engine:
         return Engine(
             data_source_cls=RecommendationDataSource,
-            preparator_cls=_IdentityPrep,
+            preparator_cls=IdentityPreparator,
             algorithm_cls_map={"als": ALSAlgorithm},
             serving_cls=FirstServing,
             query_cls=Query,
         )
 
-
-class _IdentityPrep(Preparator):
-    def prepare(self, ctx, td: TrainingData) -> PreparedData:
-        return td

@@ -1,0 +1,158 @@
+"""Model library correctness: NB, RF, co-occurrence/LLR, Markov chain,
+binary vectorizer.
+
+Parity model: e2 tests (CategoricalNaiveBayes/MarkovChain/BinaryVectorizer
+specs) + behavioral checks standing in for MLlib NaiveBayes/RandomForest.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.binary_vectorizer import BinaryVectorizer
+from predictionio_tpu.models.cooccurrence import (
+    cooccurrence_matrix,
+    llr_scores,
+    train_cooccurrence,
+)
+from predictionio_tpu.models.markov_chain import train_markov_chain
+from predictionio_tpu.models.naive_bayes import (
+    train_categorical_nb,
+    train_multinomial_nb,
+)
+from predictionio_tpu.models.random_forest import RFConfig, train_random_forest
+from predictionio_tpu.data.batch import Interactions
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create()
+
+
+class TestMultinomialNB:
+    def test_separable_classes(self, ctx):
+        rng = np.random.default_rng(0)
+        n = 200
+        # class A heavy on features 0-1, class B on features 2-3
+        xa = rng.poisson([5, 5, 0.5, 0.5], (n, 4))
+        xb = rng.poisson([0.5, 0.5, 5, 5], (n, 4))
+        x = np.vstack([xa, xb]).astype(np.float32)
+        y = ["A"] * n + ["B"] * n
+        model = train_multinomial_nb(ctx, x, y)
+        assert model.predict(np.array([6, 4, 0, 1], np.float32)) == "A"
+        assert model.predict(np.array([0, 1, 7, 4], np.float32)) == "B"
+        acc = np.mean(
+            [model.predict(x[i]) == y[i] for i in range(0, len(y), 10)]
+        )
+        assert acc > 0.95
+
+    def test_priors_reflect_imbalance(self, ctx):
+        x = np.ones((30, 2), np.float32)
+        y = ["maj"] * 25 + ["min"] * 5
+        model = train_multinomial_nb(ctx, x, y)
+        maj = model.label_map["maj"]
+        mini = model.label_map["min"]
+        assert model.log_prior[maj] > model.log_prior[mini]
+
+
+class TestCategoricalNB:
+    def test_predict_and_unseen_value(self, ctx):
+        points = [
+            ("spam", ["offer", "night"]),
+            ("spam", ["offer", "day"]),
+            ("ham", ["meeting", "day"]),
+            ("ham", ["meeting", "night"]),
+            ("ham", ["lunch", "day"]),
+        ]
+        model = train_categorical_nb(ctx, points)
+        assert model.predict(["offer", "day"]) == "spam"
+        assert model.predict(["meeting", "night"]) == "ham"
+        # unseen value with -inf default → None (reference logScore contract)
+        assert model.log_score(["never-seen", "day"]) is None
+        # with a finite default it falls back to priors+seen features
+        assert model.predict(["never-seen", "day"]) in ("spam", "ham")
+
+
+class TestRandomForest:
+    def test_xor_nonlinear(self, ctx):
+        rng = np.random.default_rng(1)
+        n = 400
+        x = rng.uniform(-1, 1, (n, 2)).astype(np.float32)
+        y = ["pos" if (a > 0) != (b > 0) else "neg" for a, b in x]
+        model = train_random_forest(
+            ctx, x, y, RFConfig(n_trees=15, max_depth=4, n_bins=16)
+        )
+        test = np.array(
+            [[0.5, -0.5], [-0.5, 0.5], [0.5, 0.5], [-0.5, -0.5]], np.float32
+        )
+        preds = [model.predict(t) for t in test]
+        assert preds == ["pos", "pos", "neg", "neg"]
+
+    def test_majority_fallback_constant_labels(self, ctx):
+        x = np.random.default_rng(2).uniform(size=(50, 3)).astype(np.float32)
+        model = train_random_forest(ctx, x, ["only"] * 50, RFConfig(n_trees=3))
+        assert model.predict(x[0]) == "only"
+
+
+def make_interactions(rows, n_users, n_items):
+    u, i = map(np.array, zip(*rows))
+    return Interactions(
+        user=u.astype(np.int32),
+        item=i.astype(np.int32),
+        rating=np.ones(len(rows), np.float32),
+        t=np.zeros(len(rows)),
+        user_map=BiMap.string_int(f"u{k}" for k in range(n_users)),
+        item_map=BiMap.string_int(f"i{k}" for k in range(n_items)),
+    )
+
+
+class TestCooccurrence:
+    def test_counts_match_bruteforce(self, ctx):
+        rows = [(0, 0), (0, 1), (1, 0), (1, 1), (1, 2), (2, 2), (2, 0)]
+        inter = make_interactions(rows, 3, 3)
+        C = np.asarray(cooccurrence_matrix(ctx, inter))
+        # item0&1 co-occur for users 0,1 → 2; item0&2 for users 1,2 → 2; 1&2 → 1
+        assert C[0, 1] == 2 and C[1, 0] == 2
+        assert C[0, 2] == 2 and C[1, 2] == 1
+        assert C[0, 0] == 3  # item0 appears for 3 users
+
+    def test_topn_excludes_self(self, ctx):
+        rows = [(u, i) for u in range(10) for i in (0, 1)] + [(0, 2)]
+        inter = make_interactions(rows, 10, 3)
+        model = train_cooccurrence(ctx, inter, n=2)
+        idx, scores = model.similar(0, 2)
+        assert 0 not in idx
+        assert idx[0] == 1 and scores[0] == 10
+
+    def test_llr_downweights_popular(self, ctx):
+        C = np.array(
+            [[50.0, 10.0, 2.0], [10.0, 60.0, 1.0], [2.0, 1.0, 4.0]], np.float32
+        )
+        import jax.numpy as jnp
+
+        llr = np.asarray(llr_scores(jnp.asarray(C)))
+        assert llr.shape == C.shape
+        assert np.all(llr >= 0)
+        assert np.all(llr[C == 0] == 0)
+
+
+class TestMarkovChain:
+    def test_transition_probs(self, ctx):
+        frm = np.array([0, 0, 0, 1, 1, 2])
+        to = np.array([1, 1, 2, 0, 2, 2])
+        model = train_markov_chain(ctx, frm, to, n_states=3, top_n=2)
+        idx, p = model.transition(0)
+        assert idx[0] == 1 and p[0] == pytest.approx(2 / 3)
+        assert idx[1] == 2 and p[1] == pytest.approx(1 / 3)
+
+
+class TestBinaryVectorizer:
+    def test_fit_transform(self):
+        rows = [{"color": "red", "size": "L"}, {"color": "blue"}]
+        v = BinaryVectorizer.fit(rows, ["color", "size"])
+        assert v.width == 3
+        x = v.transform({"color": "red", "size": "L"})
+        assert x.sum() == 2 and x[v.index["color=red"]] == 1
+        # unseen value ignored
+        assert v.transform({"color": "green"}).sum() == 0

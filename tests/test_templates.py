@@ -1,0 +1,300 @@
+"""Classification / similar-product / e-commerce template e2e tests.
+
+Parity model: the reference example templates' expected behaviors
+(SURVEY.md §2.6 workload matrix).
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import Event
+from predictionio_tpu.data import store as store_mod
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@pytest.fixture()
+def app(storage):
+    store_mod.set_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(App(0, "tapp"))
+    storage.get_l_events().init(app_id)
+    yield {"storage": storage, "app_id": app_id, "le": storage.get_l_events()}
+    store_mod.set_storage(None)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create()
+
+
+class TestClassificationTemplate:
+    def seed_users(self, le, app_id):
+        rng = np.random.default_rng(0)
+        for i in range(120):
+            # plan "premium" iff attr0 + attr1 > 10
+            a0, a1, a2 = rng.uniform(0, 10, 3)
+            plan = "premium" if a0 + a1 > 10 else "basic"
+            le.insert(
+                Event(
+                    event="$set",
+                    entity_type="user",
+                    entity_id=f"u{i}",
+                    properties={
+                        "attr0": a0, "attr1": a1, "attr2": a2, "plan": plan
+                    },
+                ),
+                app_id,
+            )
+
+    def test_both_algorithms_end_to_end(self, app, ctx):
+        from predictionio_tpu.templates.classification import (
+            ClassificationEngine,
+            Query,
+        )
+
+        self.seed_users(app["le"], app["app_id"])
+        engine = ClassificationEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {"name": "naive", "params": {"lambda": 1.0}},
+                    {"name": "randomforest", "params": {"numTrees": 8, "maxDepth": 4}},
+                ],
+            }
+        )
+        models = engine.train(ctx, ep)
+        algos = engine.make_algorithms(ep)
+        for algo, model in zip(algos, models):
+            hi = algo.predict(model, Query(features=[9.0, 9.0, 5.0]))
+            lo = algo.predict(model, Query(features=[1.0, 1.0, 5.0]))
+            assert hi.label == "premium", type(algo).__name__
+            assert lo.label == "basic", type(algo).__name__
+
+    def test_evaluation_accuracy(self, app, ctx):
+        from predictionio_tpu.templates.classification import (
+            Accuracy,
+            ClassificationEngine,
+        )
+
+        self.seed_users(app["le"], app["app_id"])
+        engine = ClassificationEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [{"name": "naive"}],
+            }
+        )
+        results = engine.eval(ctx, ep)
+        acc = Accuracy().calculate(ctx, results)
+        assert acc > 0.6  # NB on a linearly separable-ish synthetic task
+
+
+class TestSimilarProductTemplate:
+    def seed_views(self, le, app_id):
+        rng = np.random.default_rng(5)
+        # groups of co-viewed items: {i0..i4} and {i5..i9}
+        for u in range(40):
+            items = range(0, 5) if u % 2 == 0 else range(5, 10)
+            for i in rng.choice(list(items), size=3, replace=False):
+                le.insert(
+                    Event(
+                        event="view",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                    ),
+                    app_id,
+                )
+        for i in range(10):
+            le.insert(
+                Event(
+                    event="$set",
+                    entity_type="item",
+                    entity_id=f"i{i}",
+                    properties={"categories": ["even" if i % 2 == 0 else "odd"]},
+                ),
+                app_id,
+            )
+
+    def test_multi_algo_similarity(self, app, ctx):
+        from predictionio_tpu.templates.similarproduct import (
+            Query,
+            SimilarProductEngine,
+        )
+
+        self.seed_views(app["le"], app["app_id"])
+        engine = SimilarProductEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {"name": "als", "params": {"rank": 6, "numIterations": 6}},
+                    {"name": "cooccurrence", "params": {"n": 5}},
+                ],
+            }
+        )
+        models = engine.train(ctx, ep)
+        algos = engine.make_algorithms(ep)
+        serving = engine.make_serving(ep)
+
+        def query(q):
+            qq = serving.supplement(q)
+            return serving.serve(qq, [a.predict(m, qq) for a, m in zip(algos, models)])
+
+        res = query(Query(items=["i0"], num=4))
+        assert res.itemScores
+        assert "i0" not in {s.item for s in res.itemScores}  # self excluded
+        in_group = sum(
+            1 for s in res.itemScores if int(s.item[1:]) < 5
+        )
+        assert in_group >= 3  # same co-view group dominates
+
+        # category filter
+        res_cat = query(Query(items=["i0"], num=4, categories=["odd"]))
+        assert all(int(s.item[1:]) % 2 == 1 for s in res_cat.itemScores)
+
+        # blackList
+        top = res.itemScores[0].item
+        res_bl = query(Query(items=["i0"], num=4, blackList=[top]))
+        assert top not in {s.item for s in res_bl.itemScores}
+
+        # unknown item → empty
+        assert query(Query(items=["zzz"], num=3)).itemScores == []
+
+    def test_llr_mode(self, app, ctx):
+        from predictionio_tpu.templates.similarproduct import (
+            Query,
+            SimilarProductEngine,
+        )
+
+        self.seed_views(app["le"], app["app_id"])
+        engine = SimilarProductEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {"name": "cooccurrence", "params": {"n": 5, "llr": True}}
+                ],
+            }
+        )
+        models = engine.train(ctx, ep)
+        algo = engine.make_algorithms(ep)[0]
+        res = algo.predict(models[0], Query(items=["i0"], num=3))
+        assert res.itemScores and all(s.score > 0 for s in res.itemScores)
+
+
+class TestECommerceTemplate:
+    def seed(self, le, app_id):
+        rng = np.random.default_rng(9)
+        for u in range(30):
+            items = range(0, 6) if u % 2 == 0 else range(6, 12)
+            for i in rng.choice(list(items), size=4, replace=False):
+                le.insert(
+                    Event(
+                        event="view",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                    ),
+                    app_id,
+                )
+        for i in range(12):
+            le.insert(
+                Event(
+                    event="$set",
+                    entity_type="item",
+                    entity_id=f"i{i}",
+                    properties={"categories": ["low" if i < 6 else "high"]},
+                ),
+                app_id,
+            )
+
+    def make(self, ctx, unseen_only=False):
+        from predictionio_tpu.templates.ecommerce import ECommerceEngine
+
+        engine = ECommerceEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {
+                        "name": "ecomm",
+                        "params": {
+                            "appName": "tapp",
+                            "rank": 6,
+                            "numIterations": 6,
+                            "unseenOnly": unseen_only,
+                        },
+                    }
+                ],
+            }
+        )
+        models = engine.train(ctx, ep)
+        return engine.make_algorithms(ep)[0], models[0]
+
+    def test_known_user_and_filters(self, app, ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        self.seed(app["le"], app["app_id"])
+        algo, model = self.make(ctx)
+        res = algo.predict(model, Query(user="u0", num=4))
+        assert len(res.itemScores) == 4
+        res_cat = algo.predict(model, Query(user="u0", num=4, categories=["high"]))
+        assert all(int(s.item[1:]) >= 6 for s in res_cat.itemScores)
+        res_white = algo.predict(
+            model, Query(user="u0", num=4, whiteList=["i1", "i2"])
+        )
+        assert {s.item for s in res_white.itemScores} <= {"i1", "i2"}
+
+    def test_unknown_user_popular_fallback(self, app, ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        self.seed(app["le"], app["app_id"])
+        algo, model = self.make(ctx)
+        res = algo.predict(model, Query(user="stranger", num=3))
+        assert len(res.itemScores) == 3  # popularity fallback, not empty
+
+    def test_unseen_only_live_lookup(self, app, ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        self.seed(app["le"], app["app_id"])
+        algo, model = self.make(ctx, unseen_only=True)
+        seen = algo._seen_items("u0")
+        assert seen  # u0 viewed something
+        res = algo.predict(model, Query(user="u0", num=6))
+        assert not seen & {s.item for s in res.itemScores}
+
+    def test_unavailable_items_constraint(self, app, ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        self.seed(app["le"], app["app_id"])
+        algo, model = self.make(ctx)
+        res = algo.predict(model, Query(user="u0", num=3))
+        block = res.itemScores[0].item
+        # operator marks the top item unavailable via the constraint entity
+        app["le"].insert(
+            Event(
+                event="$set",
+                entity_type="constraint",
+                entity_id="unavailableItems",
+                properties={"items": [block]},
+            ),
+            app["app_id"],
+        )
+        res2 = algo.predict(model, Query(user="u0", num=3))
+        assert block not in {s.item for s in res2.itemScores}
+        # and re-enabling (empty list) brings it back — live lookup each query
+        app["le"].insert(
+            Event(
+                event="$set",
+                entity_type="constraint",
+                entity_id="unavailableItems",
+                properties={"items": []},
+            ),
+            app["app_id"],
+        )
+        res3 = algo.predict(model, Query(user="u0", num=3))
+        assert block in {s.item for s in res3.itemScores}
