@@ -68,6 +68,17 @@ def _register_builtin():
     register_driver("sqlite", sqlite_daos)
     register_driver("jdbc", sqlite_daos)  # config-compat alias
     register_driver("localfs", {"Models": localfs.LocalFSModels})
+    import importlib.util
+
+    if importlib.util.find_spec("pyarrow") is not None:
+        from predictionio_tpu.data.storage import parquet
+
+        register_driver(
+            "parquet",
+            {"LEvents": parquet.ParquetLEvents, "PEvents": parquet.ParquetPEvents},
+        )
+    else:  # pyarrow not installed: driver unavailable at registration time
+        logger.info("pyarrow unavailable; parquet storage driver disabled")
 
 
 _register_builtin()

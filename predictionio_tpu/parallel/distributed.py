@@ -1,0 +1,78 @@
+"""Multi-host distributed runtime: the NCCL/MPI-backend role, XLA-style.
+
+The reference scales out by launching Spark executors over a cluster
+(``tools/Runner.runOnSpark``, SURVEY.md §2.7); its compute-plane transport is
+Spark block shuffle.  Here the transport is XLA collectives over ICI within a
+slice and DCN across slices — all that's needed at the framework level is to
+initialize ``jax.distributed`` on every host so ``jax.devices()`` becomes the
+GLOBAL device set, after which the existing ``MeshContext`` code is unchanged
+(meshes span hosts transparently; shardings lay collectives onto ICI first).
+
+Launch contract (one process per host, same program):
+
+    PIO_COORDINATOR=host0:1234 PIO_NUM_PROCESSES=4 PIO_PROCESS_ID=2 pio train ...
+
+or explicit :func:`initialize` arguments.  On single host nothing happens.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def is_multihost_env() -> bool:
+    return "PIO_COORDINATOR" in os.environ
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed from args or PIO_* env; True if multi-host.
+
+    Safe to call unconditionally: single-host (no coordinator configured)
+    returns False without touching jax.
+    """
+    global _initialized
+    coordinator_address = coordinator_address or os.environ.get("PIO_COORDINATOR")
+    if coordinator_address is None:
+        return False
+    if _initialized:
+        return True
+    if num_processes is None:
+        num_processes = int(os.environ.get("PIO_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("PIO_PROCESS_ID", "0"))
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed initialized: process %d/%d via %s; %d global devices",
+        process_id,
+        num_processes,
+        coordinator_address,
+        len(jax.devices()),
+    )
+    return True
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    return process_index() == 0

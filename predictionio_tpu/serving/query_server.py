@@ -36,6 +36,7 @@ from predictionio_tpu.core.workflow import (
 )
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.utils.profiling import LatencyHistogram
 
 logger = logging.getLogger(__name__)
 
@@ -108,10 +109,12 @@ class QueryServer:
         self.plugins = list(plugins or [])
         self._deployed: Optional[_Deployed] = None
         self._lock = threading.Lock()
-        # latency bookkeeping (parity: CreateServer.scala:415-417)
+        # latency bookkeeping (parity: CreateServer.scala:415-417) plus a
+        # full histogram (TPU-build observability, SURVEY.md §5)
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
+        self.latency = LatencyHistogram()
         self.service = HttpService("queryserver")
         self._register_routes()
         self.reload()
@@ -165,6 +168,7 @@ class QueryServer:
             result["prId"] = pr_id
             self._send_feedback(data, result, pr_id, deployed.instance_id)
         dt = time.perf_counter() - t0
+        self.latency.observe(dt)
         with self._lock:
             self.request_count += 1
             self.last_serving_sec = dt
@@ -219,6 +223,7 @@ class QueryServer:
                     "requestCount": self.request_count,
                     "avgServingSec": self.avg_serving_sec,
                     "lastServingSec": self.last_serving_sec,
+                    "latency": self.latency.summary(),
                     "feedback": self.feedback,
                 }
             return json_response(200, info)

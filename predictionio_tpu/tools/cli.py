@@ -70,10 +70,28 @@ def resolve_engine_from_variant(variant: dict):
 
 
 def make_ctx(variant: dict):
+    from predictionio_tpu.parallel import distributed
     from predictionio_tpu.parallel.mesh import MeshContext
 
+    distributed.initialize()  # no-op unless PIO_COORDINATOR is set
     conf = variant.get("mesh") or {}
     return MeshContext.create(conf=conf)
+
+
+def load_plugins(paths: list[str]) -> list:
+    """--plugin dotted.path.Class → instances (ServiceLoader replacement)."""
+    from predictionio_tpu.core.persistence import resolve_class
+
+    return [resolve_class(p)() for p in paths or []]
+
+
+BUILTIN_TEMPLATES = {
+    "recommendation": "predictionio_tpu.templates.recommendation.RecommendationEngine",
+    "classification": "predictionio_tpu.templates.classification.ClassificationEngine",
+    "similarproduct": "predictionio_tpu.templates.similarproduct.SimilarProductEngine",
+    "ecommercerecommendation": "predictionio_tpu.templates.ecommerce.ECommerceEngine",
+    "python": "predictionio_tpu.pypio.PythonEngine",
+}
 
 
 # -- verbs --------------------------------------------------------------------
@@ -285,6 +303,7 @@ def cmd_deploy(args) -> int:
             else None
         ),
         access_key=args.accesskey,
+        plugins=load_plugins(args.plugin),
     )
     port = qs.start(args.ip, args.port)
     print(f"[INFO] Engine is deployed and running. Engine API is live at "
@@ -333,7 +352,9 @@ def cmd_batchpredict(args) -> int:
 def cmd_eventserver(args) -> int:
     from predictionio_tpu.data.api.event_server import EventServer
 
-    es = EventServer(storage=_storage(), stats=args.stats)
+    es = EventServer(
+        storage=_storage(), stats=args.stats, plugins=load_plugins(args.plugin)
+    )
     port = es.start(args.ip, args.port)
     print(f"[INFO] Event Server is listening at http://{args.ip}:{port}")
     try:
@@ -366,6 +387,47 @@ def cmd_dashboard(args) -> int:
         server.service.serve_forever()
     except KeyboardInterrupt:
         server.stop()
+    return 0
+
+
+def cmd_template(args) -> int:
+    # parity: `pio template list/get` — templates ship in-tree here
+    if args.template_command == "list":
+        for name, factory in BUILTIN_TEMPLATES.items():
+            print(f"{name:<26} {factory}")
+        return 0
+    if args.template_command == "get":
+        name = args.name
+        if name not in BUILTIN_TEMPLATES:
+            return _die(f"Unknown template {name}. Try `pio template list`.")
+        factory = BUILTIN_TEMPLATES[name]
+        os.makedirs(args.directory or name, exist_ok=True)
+        path = os.path.join(args.directory or name, "engine.json")
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "id": "default",
+                    "description": f"{name} template",
+                    "engineFactory": factory,
+                    "datasource": {"params": {"appName": "CHANGE_ME"}},
+                    "algorithms": [],
+                },
+                f,
+                indent=2,
+            )
+        print(f"[INFO] Engine skeleton created at {path}")
+        return 0
+    return _die(f"unknown template command {args.template_command}")
+
+
+def cmd_run(args) -> int:
+    """Parity: `pio run <main-class>` — execute a dotted callable in-process."""
+    from predictionio_tpu.core.persistence import resolve_class
+
+    obj = resolve_class(args.main)
+    result = obj(*args.args) if callable(obj) else None
+    if result is not None:
+        print(result)
     return 0
 
 
@@ -461,6 +523,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--event-server-ip", default="0.0.0.0")
     sp.add_argument("--event-server-port", type=int, default=7070)
     sp.add_argument("--accesskey", default=None)
+    sp.add_argument("--plugin", action="append", default=[])
     sp.set_defaults(func=cmd_deploy)
 
     sp = sub.add_parser("undeploy")
@@ -478,6 +541,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ip", default="0.0.0.0")
     sp.add_argument("--port", type=int, default=7070)
     sp.add_argument("--stats", action="store_true")
+    sp.add_argument("--plugin", action="append", default=[])
     sp.set_defaults(func=cmd_eventserver)
 
     sp = sub.add_parser("adminserver")
@@ -489,6 +553,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ip", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=9000)
     sp.set_defaults(func=cmd_dashboard)
+
+    sp = sub.add_parser("template")
+    t_sub = sp.add_subparsers(dest="template_command", required=True)
+    t_sub.add_parser("list")
+    x = t_sub.add_parser("get")
+    x.add_argument("name")
+    x.add_argument("--directory", default=None)
+    sp.set_defaults(func=cmd_template)
+
+    sp = sub.add_parser("run")
+    sp.add_argument("main")
+    sp.add_argument("args", nargs="*")
+    sp.set_defaults(func=cmd_run)
 
     sp = sub.add_parser("export")
     sp.add_argument("--appid", type=int, required=True)

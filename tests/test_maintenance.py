@@ -1,0 +1,141 @@
+"""Parquet compaction, SelfCleaningDataSource, CrossValidation tests."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.cross_validation import k_fold, k_fold_indices
+from predictionio_tpu.core.self_cleaning import (
+    EventWindow,
+    clean_persisted_events,
+    parse_duration,
+)
+from predictionio_tpu.data.event import Event, utcnow
+
+UTC = dt.timezone.utc
+
+
+def ev(event, eid, t_offset_s=0, props=None, target=None):
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=props or {},
+        event_time=utcnow() - dt.timedelta(seconds=-t_offset_s),
+    )
+
+
+class TestParquetCompaction:
+    def test_wal_folds_into_part_and_reads_survive(self, tmp_path):
+        from predictionio_tpu.data.storage.parquet import (
+            ParquetLEvents,
+            ParquetPEvents,
+            _Namespace,
+        )
+
+        le = ParquetLEvents(path=str(tmp_path))
+        le.init(1)
+        ids = le.batch_insert(
+            [ev("buy", f"u{i}", t_offset_s=-i, target="i1") for i in range(20)], 1
+        )
+        le.delete(ids[0], 1)
+        ns = _Namespace(str(tmp_path), 1, None)
+        assert ns.part_paths() == []  # below threshold: still WAL-only
+        ns.compact(force=True)
+        assert len(ns.part_paths()) == 1
+        assert not ns.read_wal()
+        # reads identical post-compaction; tombstone applied
+        events = list(le.find(1))
+        assert len(events) == 19
+        assert ids[0] not in {e.event_id for e in events}
+        # columnar bulk read straight from the part
+        batch = ParquetPEvents(path=str(tmp_path)).find(1, event_names=["buy"])
+        assert len(batch) == 19
+        assert batch.properties[0] == {}
+        # new writes after compaction land in a fresh WAL and merge on read
+        le.insert(ev("buy", "u99", target="i1"), 1)
+        assert len(list(le.find(1))) == 20
+
+
+class TestSelfCleaning:
+    def test_compress_dedup_window(self, storage):
+        le = storage.get_l_events()
+        le.init(5)
+        old = utcnow() - dt.timedelta(days=10)
+        # old event outside the window
+        le.insert(
+            Event(event="buy", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  event_time=old),
+            5,
+        )
+        # property churn to be compressed
+        le.insert(ev("$set", "u1", props={"a": 1}), 5)
+        le.insert(ev("$set", "u1", props={"b": 2}), 5)
+        le.insert(ev("$unset", "u1", props={"a": 0}), 5)
+        # duplicate regular events
+        base = utcnow()
+        for _ in range(3):
+            le.insert(
+                Event(event="view", entity_type="user", entity_id="u2",
+                      target_entity_type="item", target_entity_id="i2",
+                      event_time=base),
+                5,
+            )
+        stats = clean_persisted_events(
+            storage, 5,
+            EventWindow(duration="7 days", remove_duplicates=True,
+                        compress_properties=True),
+        )
+        assert stats["before"] == 7
+        events = list(le.find(5))
+        assert stats["after"] == len(events) == 2
+        sets = [e for e in events if e.event == "$set"]
+        assert len(sets) == 1 and sets[0].properties.to_dict() == {"b": 2}
+        views = [e for e in events if e.event == "view"]
+        assert len(views) == 1
+
+    def test_old_property_events_exempt_from_window(self, storage):
+        # parity: isAfter(cutoff) || isSetEvent — old $set must NOT be dropped
+        le = storage.get_l_events()
+        le.init(6)
+        old = utcnow() - dt.timedelta(days=30)
+        le.insert(
+            Event(event="$set", entity_type="user", entity_id="u1",
+                  properties={"plan": "pro"}, event_time=old),
+            6,
+        )
+        clean_persisted_events(storage, 6, EventWindow(duration="7 days"))
+        snap = le.aggregate_properties(6, "user")
+        assert snap["u1"].to_dict() == {"plan": "pro"}
+
+    def test_parse_duration(self):
+        assert parse_duration(90) == 90
+        assert parse_duration("2 days") == 172800
+        assert parse_duration("1 hour") == 3600
+        with pytest.raises(ValueError):
+            parse_duration("fortnight")
+
+
+class TestCrossValidation:
+    def test_k_fold_partition(self):
+        folds = k_fold_indices(10, 3)
+        assert len(folds) == 3
+        all_test = np.concatenate([te for _, te in folds])
+        assert sorted(all_test.tolist()) == list(range(10))
+        for tr, te in folds:
+            assert set(tr) | set(te) == set(range(10))
+            assert not set(tr) & set(te)
+
+    def test_k_fold_materialized(self):
+        data = list("abcdef")
+        folds = k_fold(data, 2)
+        assert folds[0][1] == ["a", "c", "e"]  # fold 0 test rows: i%2==0
+        assert folds[0][0] == ["b", "d", "f"]
+
+    def test_k_too_small(self):
+        with pytest.raises(ValueError):
+            k_fold_indices(5, 1)

@@ -1,0 +1,102 @@
+"""pypio compat, latency histogram, distributed init guard, CLI template/run."""
+
+import json
+
+import pytest
+
+from predictionio_tpu.data import Event
+from predictionio_tpu.utils.profiling import LatencyHistogram
+
+
+class TinyModel:
+    def predict(self, q):
+        return q["x"] * 2
+
+
+class TestPypio:
+    def test_init_find_save_deploy_cycle(self, storage):
+        from predictionio_tpu import pypio
+        from predictionio_tpu.core.workflow import prepare_deploy
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.parallel.mesh import MeshContext
+
+        app_id = storage.get_meta_data_apps().insert(App(0, "pyapp"))
+        le = storage.get_l_events()
+        le.init(app_id)
+        le.insert(
+            Event(event="buy", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1"),
+            app_id,
+        )
+        pypio.init(storage)
+        try:
+            batch = pypio.find_events("pyapp")
+            assert len(batch) == 1
+
+            iid = pypio.save_model(TinyModel())
+            inst = storage.get_meta_data_engine_instances().get(iid)
+            assert inst.status == "COMPLETED"
+            engine = pypio.PythonEngine.apply()
+            _, algos, serving, models = prepare_deploy(
+                engine, inst, storage=storage, ctx=MeshContext.create()
+            )
+            out = algos[0].predict(models[0], {"x": 21})
+            assert out == {"prediction": 42}
+        finally:
+            from predictionio_tpu.data import store as store_mod
+
+            store_mod.set_storage(None)
+
+    def test_requires_init(self):
+        import importlib
+
+        from predictionio_tpu import pypio
+
+        pypio._storage = None
+        with pytest.raises(RuntimeError, match="init"):
+            pypio.find_events("x")
+
+
+class TestLatencyHistogram:
+    def test_quantiles(self):
+        h = LatencyHistogram()
+        for _ in range(90):
+            h.observe(0.001)  # 1ms
+        for _ in range(10):
+            h.observe(0.1)  # 100ms
+        s = h.summary()
+        assert s["count"] == 100
+        assert s["p50Ms"] <= 2.0
+        assert s["p99Ms"] >= 50.0
+
+    def test_empty(self):
+        assert LatencyHistogram().summary()["p50Ms"] == 0.0
+
+
+class TestDistributed:
+    def test_noop_without_coordinator(self, monkeypatch):
+        from predictionio_tpu.parallel import distributed
+
+        monkeypatch.delenv("PIO_COORDINATOR", raising=False)
+        assert distributed.initialize() is False
+        assert distributed.is_multihost_env() is False
+
+
+class TestCliTemplateAndRun:
+    def test_template_list_and_get(self, tmp_path, capsys):
+        from predictionio_tpu.tools.cli import main
+
+        assert main(["template", "list"]) == 0
+        out = capsys.readouterr().out
+        assert "recommendation" in out and "ecommercerecommendation" in out
+        d = tmp_path / "myengine"
+        assert main(["template", "get", "recommendation", "--directory", str(d)]) == 0
+        variant = json.loads((d / "engine.json").read_text())
+        assert variant["engineFactory"].endswith("RecommendationEngine")
+        assert main(["template", "get", "nope"]) == 1
+
+    def test_run_verb(self, capsys):
+        from predictionio_tpu.tools.cli import main
+
+        assert main(["run", "predictionio_tpu.data.event.utcnow"]) == 0
+        assert "20" in capsys.readouterr().out  # printed a datetime

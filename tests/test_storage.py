@@ -31,7 +31,7 @@ def ev(event, eid, t=0, target=None, props=None):
     )
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "parquet"])
 def driver_env(request, tmp_path):
     name = "T" + uuid.uuid4().hex[:8].upper()
     env = {
@@ -42,14 +42,18 @@ def driver_env(request, tmp_path):
     }
     if request.param == "sqlite":
         env[f"PIO_STORAGE_SOURCES_{name}_PATH"] = str(tmp_path / "pio.sqlite")
+    elif request.param == "parquet":
+        # parquet implements EVENTDATA only; meta/model repos use memory
+        env[f"PIO_STORAGE_SOURCES_{name}_PATH"] = str(tmp_path / "pq")
+        env[f"PIO_STORAGE_SOURCES_{name}META_TYPE"] = "memory"
+        env["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = name + "META"
+        env["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = name + "META"
     yield env
-    if request.param == "memory":
-        from predictionio_tpu.data.storage import memory
+    from predictionio_tpu.data.storage import memory, sqlite
 
-        memory.reset_store(name)
-    else:
-        from predictionio_tpu.data.storage import sqlite
-
+    memory.reset_store(name)
+    memory.reset_store(name + "META")
+    if request.param == "sqlite":
         sqlite.close_db(str(tmp_path / "pio.sqlite"))
 
 
