@@ -1,0 +1,206 @@
+"""Sequential recommender: causal-transformer next-item prediction (SASRec-style).
+
+Beyond reference parity (the reference predates sequence models entirely —
+SURVEY.md §5 "long-context: absent"), this adds the modern sequential
+model family the long-context machinery exists for: per-user event histories
+become item-id sequences; a small causal transformer is trained to predict
+the next item; recommendation = ranking logits of the last position.
+
+TPU-first: one jitted, donated train step; the batch dimension is sharded
+over the mesh ``data`` axis (pure DP — gradients all-reduced by XLA); the
+attention is the same causal kernel ring attention provides, so sequence
+parallelism over a ``seq`` mesh axis composes when histories outgrow a chip
+(``parallel/ring.py``).  Optimizer: optax adam.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from predictionio_tpu.data.batch import Interactions
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.parallel.mesh import DATA_AXIS, MeshContext, pad_to_multiple
+from predictionio_tpu.parallel.ring import full_attention
+
+PAD = 0  # item ids are shifted by +1; 0 is the padding token
+
+
+@dataclasses.dataclass(frozen=True)  # hashable: passed as a static jit arg
+class SASRecConfig:
+    d_model: int = 32
+    n_layers: int = 2
+    n_heads: int = 2
+    max_len: int = 32
+    epochs: int = 20
+    batch_size: int = 128
+    lr: float = 1e-2
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class SASRecModel:
+    params: dict  # host pytree
+    item_map: BiMap
+    config: SASRecConfig
+
+    def recommend(
+        self, history: list[str], num: int, exclude_history: bool = True
+    ) -> tuple[list[str], np.ndarray]:
+        idx = [self.item_map[i] for i in history if i in self.item_map]
+        if not idx:
+            return [], np.array([])
+        cfg = self.config
+        seq = np.zeros(cfg.max_len, np.int32)
+        tail = idx[-cfg.max_len:]
+        seq[-len(tail):] = np.asarray(tail) + 1
+        logits = np.array(_predict_logits(self.params, seq[None, :], cfg))[0]
+        if exclude_history:
+            logits[np.asarray(idx)] = -1e30
+        k = min(num, len(logits))
+        top = np.argpartition(-logits, k - 1)[:k]
+        top = top[np.argsort(-logits[top])]
+        top = top[logits[top] > -1e29]  # drop excluded-item sentinels
+        inv = self.item_map.inverse
+        return [inv[int(i)] for i in top], logits[top]
+
+
+def build_sequences(
+    interactions: Interactions, max_len: int
+) -> np.ndarray:
+    """(n_users, max_len) right-aligned, time-ordered item ids (+1; 0=pad)."""
+    order = np.lexsort((interactions.t, interactions.user))
+    users = interactions.user[order]
+    items = interactions.item[order]
+    n_users = interactions.n_users
+    seqs = np.zeros((n_users, max_len), np.int32)
+    bounds = np.flatnonzero(np.diff(users)) + 1
+    for u_block, i_block in zip(np.split(users, bounds), np.split(items, bounds)):
+        if len(u_block) == 0:
+            continue
+        u = int(u_block[0])
+        tail = i_block[-max_len:]
+        seqs[u, -len(tail):] = tail + 1
+    return seqs
+
+
+def _init_params(key, cfg: SASRecConfig, n_items: int) -> dict:
+    keys = jax.random.split(key, 2 + cfg.n_layers * 4)
+    d = cfg.d_model
+    params = {
+        "emb": jax.random.normal(keys[0], (n_items + 1, d)) * 0.02,
+        "pos": jax.random.normal(keys[1], (cfg.max_len, d)) * 0.02,
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        k0, k1, k2, k3 = keys[2 + i * 4 : 6 + i * 4]
+        params["layers"].append(
+            {
+                "wqkv": jax.random.normal(k0, (d, 3 * d)) * (d**-0.5),
+                "wo": jax.random.normal(k1, (d, d)) * (d**-0.5),
+                "w1": jax.random.normal(k2, (d, 4 * d)) * (d**-0.5),
+                "w2": jax.random.normal(k3, (4 * d, d)) * ((4 * d) ** -0.5),
+                "ln1": jnp.ones(d),
+                "ln2": jnp.ones(d),
+            }
+        )
+    return params
+
+
+def _layer_norm(x, g):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g
+
+
+def _forward(params, seq, cfg: SASRecConfig):
+    """seq (B, T) int32 → hidden states (B, T, D)."""
+    x = params["emb"][seq] + params["pos"][None, :, :]
+    pad_mask = (seq == PAD)[:, :, None]
+    h = cfg.d_model // cfg.n_heads
+    for layer in params["layers"]:
+        y = _layer_norm(x, layer["ln1"])
+        qkv = y @ layer["wqkv"]  # (B, T, 3D)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):  # (B, T, D) → (B, H, T, h)
+            return z.reshape(*z.shape[:-1], cfg.n_heads, h).swapaxes(-3, -2)
+
+        a = full_attention(heads(q), heads(k), heads(v), causal=True)
+        a = a.swapaxes(-3, -2).reshape(*y.shape)
+        x = x + a @ layer["wo"]
+        y = _layer_norm(x, layer["ln2"])
+        x = x + jax.nn.relu(y @ layer["w1"]) @ layer["w2"]
+        x = jnp.where(pad_mask, 0.0, x)
+    return x
+
+
+def _loss_fn(params, seq, cfg: SASRecConfig):
+    """Causal next-item cross-entropy; positions whose TARGET is pad are
+    masked out."""
+    inputs = seq[:, :-1]
+    targets = seq[:, 1:]
+    hidden = _forward(params, inputs, cfg)  # uses pos[0:T-1]
+    logits = hidden @ params["emb"][1:].T  # (B, T-1, n_items); skip pad row
+    mask = (targets != PAD) & (inputs != PAD)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tgt = jnp.maximum(targets - 1, 0)  # back to 0-based item index
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _predict_logits(params, seq, cfg: SASRecConfig):
+    hidden = _forward(params, seq, cfg)
+    return hidden[:, -1, :] @ params["emb"][1:].T
+
+
+def train_sasrec(
+    ctx: MeshContext,
+    interactions: Interactions,
+    config: Optional[SASRecConfig] = None,
+) -> SASRecModel:
+    cfg = config or SASRecConfig()
+    n_items = interactions.n_items
+    seqs = build_sequences(interactions, cfg.max_len + 1)  # +1: input/target shift
+    # keep users with at least 2 events (one transition)
+    keep = (seqs != PAD).sum(1) >= 2
+    seqs = seqs[keep]
+    n = len(seqs)
+    if n == 0:
+        raise ValueError(
+            "no user has >= 2 interaction events; sequential training needs "
+            "at least one (previous item -> next item) transition"
+        )
+    n_shards = ctx.axis_size(DATA_AXIS)
+    batch = min(cfg.batch_size, pad_to_multiple(n, n_shards))
+    batch = pad_to_multiple(batch, n_shards)
+
+    key = jax.random.PRNGKey(cfg.seed)
+    params = _init_params(key, cfg, n_items)
+    params = jax.device_put(params, ctx.replicated())
+    opt = optax.adam(cfg.lr)
+    opt_state = jax.device_put(opt.init(params), ctx.replicated())
+    batch_sharding = ctx.sharding(DATA_AXIS, None)
+
+    @partial(jax.jit, static_argnums=(3,), donate_argnums=(0, 1))
+    def step(params, opt_state, seq, cfg):
+        loss, grads = jax.value_and_grad(_loss_fn)(params, seq, cfg)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(cfg.seed)
+    loss = None
+    for _ in range(cfg.epochs):
+        picks = rng.integers(0, n, batch)
+        sb = jax.device_put(jnp.asarray(seqs[picks]), batch_sharding)
+        params, opt_state, loss = step(params, opt_state, sb, cfg)
+    return SASRecModel(
+        params=ctx.to_host(params), item_map=interactions.item_map, config=cfg
+    )

@@ -1,0 +1,136 @@
+"""Ring attention: sequence/context parallelism over the device mesh.
+
+The reference has no sequence dimension at all (SURVEY.md §5 "long-context:
+absent"), but this framework treats long-context as first-class so sequential
+models (e.g. transformer recommenders over long user event histories) scale
+past single-chip memory from day one.
+
+Design (standard ring attention, cf. Liu et al. 2023 / the scaling-book
+recipe): the sequence axis is sharded over a mesh axis; each device holds one
+Q/K/V block. K/V blocks circulate around the ring with ``jax.lax.ppermute``
+(ICI neighbor exchanges, overlapping compute) while each device accumulates
+its queries' attention over every block using the **online-softmax** update
+(running max ``m``, denominator ``l``, numerator ``o``) — numerically exact,
+no T×T materialization, O(T_local) memory per device.
+
+``ring_attention`` is the user-facing wrapper (shard_map over the mesh);
+``_ring_attention_block`` is the per-device kernel, usable inside other
+shard_mapped programs.  Causal masking uses global block offsets so the
+result equals single-device causal attention exactly.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import MeshContext
+
+NEG_INF = -1e30
+
+
+def _ring_attention_block(q, k, v, axis_name: str, n_blocks: int, causal: bool,
+                          scale: Optional[float] = None):
+    """Per-device ring attention. q,k,v: (..., T_local, D) local blocks."""
+    t_local = q.shape[-2]
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    my_idx = jax.lax.axis_index(axis_name)
+    q_pos = my_idx * t_local + jnp.arange(t_local)  # global query positions
+
+    perm = [(j, (j + 1) % n_blocks) for j in range(n_blocks)]
+
+    def body(carry, step):
+        o, m, l, k_blk, v_blk = carry
+        # block we currently hold started at device (my_idx - step) % n_blocks
+        src = (my_idx - step) % n_blocks
+        k_pos = src * t_local + jnp.arange(t_local)
+        s = jnp.einsum("...qd,...kd->...qk", q, k_blk) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # rescale previous accumulators to the new max
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum("...qk,...kd->...qd", p, v_blk)
+        # pass K/V to the next device in the ring (ICI neighbor exchange)
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    o0 = jnp.zeros_like(q)
+    # constant-initialized carries must be marked varying over the ring axis
+    m0 = jax.lax.pcast(
+        jnp.full(q.shape[:-1], NEG_INF, q.dtype), axis_name, to="varying"
+    )
+    l0 = jax.lax.pcast(jnp.zeros(q.shape[:-1], q.dtype), axis_name, to="varying")
+    (o, m, l, _, _), _ = jax.lax.scan(
+        body, (o0, m0, l0, k, v), jnp.arange(n_blocks)
+    )
+    # fully-masked rows (can't happen with causal self-attention) guard
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def ring_attention(
+    ctx: MeshContext,
+    q,
+    k,
+    v,
+    axis: str = "data",
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Exact attention over a sequence sharded on mesh axis ``axis``.
+
+    q/k/v: (..., T, D) with T divisible by the axis size; inputs may be host
+    arrays (they are placed sharded along T).  Returns the (..., T, D)
+    result sharded the same way.
+    """
+    n_blocks = ctx.axis_size(axis)
+    t = q.shape[-2]
+    if t % n_blocks:
+        raise ValueError(f"sequence length {t} not divisible by {n_blocks} shards")
+    ndim = q.ndim
+    spec = P(*([None] * (ndim - 2) + [axis, None]))
+    sharding = ctx.sharding(*spec)
+    q, k, v = (jax.device_put(jnp.asarray(x), sharding) for x in (q, k, v))
+    fn = _build_ring_fn(ctx.mesh, axis, n_blocks, causal, scale, ndim)
+    return fn(q, k, v)
+
+
+@lru_cache(maxsize=64)
+def _build_ring_fn(mesh, axis: str, n_blocks: int, causal: bool,
+                   scale: Optional[float], ndim: int):
+    """Cache the jitted shard_map so repeat calls hit the XLA jit cache."""
+    spec = P(*([None] * (ndim - 2) + [axis, None]))
+    kernel = partial(
+        _ring_attention_block,
+        axis_name=axis,
+        n_blocks=n_blocks,
+        causal=causal,
+        scale=scale,
+    )
+    return jax.jit(
+        shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    )
+
+
+def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Single-device reference implementation (tests / small inputs)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p, v)

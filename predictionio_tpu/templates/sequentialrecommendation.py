@@ -1,0 +1,155 @@
+"""Sequential-recommendation template: next-item prediction over histories.
+
+A beyond-parity model family (the reference has no sequence models): user
+event histories train a causal-transformer recommender
+(:mod:`predictionio_tpu.models.sequential`); at query time the user's RECENT
+history is read live from the event store (same pattern as the e-commerce
+template's serving-time lookups) so recommendations track events newer than
+the model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+)
+from predictionio_tpu.core.controller import SanityCheck
+from predictionio_tpu.data.batch import Interactions
+from predictionio_tpu.data.store import LEventStore, PEventStore
+from predictionio_tpu.models.sequential import (
+    SASRecConfig,
+    SASRecModel,
+    train_sasrec,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    itemScores: list[ItemScore]
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    interactions: Interactions
+
+    def sanity_check(self):
+        if len(self.interactions) == 0:
+            raise ValueError("No interaction events found; check appName.")
+
+
+@dataclasses.dataclass
+class SeqDataSourceParams(Params):
+    appName: str = "default"
+    eventNames: tuple = ("view", "buy", "rate")
+
+
+class SequentialDataSource(DataSource):
+    params_cls = SeqDataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        batch = PEventStore.find(
+            self.params.appName,
+            entity_type="user",
+            event_names=list(self.params.eventNames),
+            target_entity_type="item",
+        )
+        return TrainingData(interactions=batch.interactions(rating_key=None))
+
+
+@dataclasses.dataclass
+class SASRecParams(Params):
+    appName: str = "default"
+    eventNames: tuple = ("view", "buy", "rate")
+    dModel: int = 32
+    numLayers: int = 2
+    numHeads: int = 2
+    maxLen: int = 32
+    epochs: int = 50
+    batchSize: int = 128
+    lr: float = 0.005
+    seed: int = 0
+
+
+class SASRecAlgorithm(Algorithm):
+    params_cls = SASRecParams
+
+    def train(self, ctx, pd: TrainingData) -> SASRecModel:
+        p = self.params
+        return train_sasrec(
+            ctx,
+            pd.interactions,
+            SASRecConfig(
+                d_model=p.dModel,
+                n_layers=p.numLayers,
+                n_heads=p.numHeads,
+                max_len=p.maxLen,
+                epochs=p.epochs,
+                batch_size=p.batchSize,
+                lr=p.lr,
+                seed=p.seed,
+            ),
+        )
+
+    def _history(self, user: str, limit: int) -> list[str]:
+        """Live recent-items lookup, oldest→newest (serving-time read)."""
+        try:
+            events = LEventStore.find_by_entity(
+                self.params.appName,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.eventNames),
+                target_entity_type="item",
+                limit=limit,
+                latest=True,
+            )
+        except Exception:
+            logger.exception("history lookup failed for %s", user)
+            return []
+        return [
+            e.target_entity_id for e in reversed(events) if e.target_entity_id
+        ]
+
+    def predict(self, model: SASRecModel, query: Query) -> PredictedResult:
+        history = self._history(query.user, model.config.max_len)
+        items, scores = model.recommend(history, query.num)
+        return PredictedResult(
+            itemScores=[
+                ItemScore(i, float(s)) for i, s in zip(items, scores)
+            ]
+        )
+
+
+class SequentialRecommendationEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source_cls=SequentialDataSource,
+            preparator_cls=IdentityPreparator,
+            algorithm_cls_map={"sasrec": SASRecAlgorithm},
+            serving_cls=FirstServing,
+            query_cls=Query,
+        )

@@ -90,6 +90,10 @@ BUILTIN_TEMPLATES = {
     "classification": "predictionio_tpu.templates.classification.ClassificationEngine",
     "similarproduct": "predictionio_tpu.templates.similarproduct.SimilarProductEngine",
     "ecommercerecommendation": "predictionio_tpu.templates.ecommerce.ECommerceEngine",
+    "sequentialrecommendation": (
+        "predictionio_tpu.templates.sequentialrecommendation."
+        "SequentialRecommendationEngine"
+    ),
     "python": "predictionio_tpu.pypio.PythonEngine",
 }
 

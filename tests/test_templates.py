@@ -185,6 +185,60 @@ class TestSimilarProductTemplate:
         assert res.itemScores and all(s.score > 0 for s in res.itemScores)
 
 
+class TestSequentialTemplate:
+    def test_end_to_end_with_live_history(self, app, ctx):
+        from predictionio_tpu.templates.sequentialrecommendation import (
+            Query,
+            SequentialRecommendationEngine,
+        )
+
+        le, app_id = app["le"], app["app_id"]
+        # every user walks 5 steps of the cycle i0→i1→…→i7→i0… so the next
+        # item is NOT in their history (history must not cover the catalog)
+        for u in range(48):
+            start = u % 8
+            for t in range(5):
+                le.insert(
+                    Event(
+                        event="view",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{(start + t) % 8}",
+                        event_time=float(1000 + t),
+                    ),
+                    app_id,
+                )
+        engine = SequentialRecommendationEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {
+                        "name": "sasrec",
+                        "params": {
+                            "appName": "tapp", "dModel": 32, "numLayers": 1,
+                            "maxLen": 8, "epochs": 120, "lr": 0.005,
+                        },
+                    }
+                ],
+            }
+        )
+        models = engine.train(ctx, ep)
+        algo = engine.make_algorithms(ep)[0]
+        # u0's history is i0..i4 → next in cycle is i5
+        res = algo.predict(models[0], Query(user="u0", num=3))
+        assert res.itemScores
+        assert all(s.score > -1e29 for s in res.itemScores)
+        assert "i5" in [s.item for s in res.itemScores][:2]
+        # history items are excluded from results
+        assert not {"i0", "i1", "i2", "i3", "i4"} & {
+            s.item for s in res.itemScores
+        }
+        # unknown user → empty history → empty result (no crash)
+        assert algo.predict(models[0], Query(user="ghost", num=3)).itemScores == []
+
+
 class TestECommerceTemplate:
     def seed(self, le, app_id):
         rng = np.random.default_rng(9)
