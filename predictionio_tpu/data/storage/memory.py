@@ -225,24 +225,27 @@ class MemoryApps(base.Apps):
 
     def get(self, app_id: int):
         with self._s.lock:
-            return self._s.apps.get(app_id)
+            a = self._s.apps.get(app_id)
+            return copy.copy(a) if a else None
 
     def get_by_name(self, name: str):
         with self._s.lock:
             for a in self._s.apps.values():
                 if a.name == name:
-                    return a
+                    return copy.copy(a)
         return None
 
     def get_all(self):
         with self._s.lock:
-            return sorted(self._s.apps.values(), key=lambda a: a.id)
+            return sorted(
+                (copy.copy(a) for a in self._s.apps.values()), key=lambda a: a.id
+            )
 
     def update(self, app: base.App) -> bool:
         with self._s.lock:
             if app.id not in self._s.apps:
                 return False
-            self._s.apps[app.id] = app
+            self._s.apps[app.id] = base.App(app.id, app.name, app.description)
             return True
 
     def delete(self, app_id: int) -> bool:
@@ -266,21 +269,28 @@ class MemoryAccessKeys(base.AccessKeys):
 
     def get(self, key: str):
         with self._s.lock:
-            return self._s.access_keys.get(key)
+            k = self._s.access_keys.get(key)
+            return copy.deepcopy(k) if k else None
 
     def get_all(self):
         with self._s.lock:
-            return list(self._s.access_keys.values())
+            return [copy.deepcopy(k) for k in self._s.access_keys.values()]
 
     def get_by_app_id(self, app_id: int):
         with self._s.lock:
-            return [k for k in self._s.access_keys.values() if k.app_id == app_id]
+            return [
+                copy.deepcopy(k)
+                for k in self._s.access_keys.values()
+                if k.app_id == app_id
+            ]
 
     def update(self, access_key: base.AccessKey) -> bool:
         with self._s.lock:
             if access_key.key not in self._s.access_keys:
                 return False
-            self._s.access_keys[access_key.key] = access_key
+            self._s.access_keys[access_key.key] = base.AccessKey(
+                access_key.key, access_key.app_id, list(access_key.events)
+            )
             return True
 
     def delete(self, key: str) -> bool:
@@ -309,11 +319,16 @@ class MemoryChannels(base.Channels):
 
     def get(self, channel_id: int):
         with self._s.lock:
-            return self._s.channels.get(channel_id)
+            c = self._s.channels.get(channel_id)
+            return copy.copy(c) if c else None
 
     def get_by_app_id(self, app_id: int):
         with self._s.lock:
-            return [c for c in self._s.channels.values() if c.app_id == app_id]
+            return [
+                copy.copy(c)
+                for c in self._s.channels.values()
+                if c.app_id == app_id
+            ]
 
     def delete(self, channel_id: int) -> bool:
         with self._s.lock:

@@ -119,8 +119,13 @@ def _layer_norm(x, g):
     return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g
 
 
-def _forward(params, seq, cfg: SASRecConfig):
-    """seq (B, T) int32 → hidden states (B, T, D)."""
+def _forward(params, seq, cfg: SASRecConfig, allow_flash: bool = False):
+    """seq (B, T) int32 → hidden states (B, T, D).
+
+    allow_flash enables the Pallas flash kernel for long blocks on the
+    INFERENCE path only — the kernel has no VJP yet, so the training loss
+    always uses the dense (differentiable) attention.
+    """
     x = params["emb"][seq] + params["pos"][None, :, :]
     pad_mask = (seq == PAD)[:, :, None]
     h = cfg.d_model // cfg.n_heads
@@ -132,7 +137,19 @@ def _forward(params, seq, cfg: SASRecConfig):
         def heads(z):  # (B, T, D) → (B, H, T, h)
             return z.reshape(*z.shape[:-1], cfg.n_heads, h).swapaxes(-3, -2)
 
-        a = full_attention(heads(q), heads(k), heads(v), causal=True)
+        t = seq.shape[-1]
+        if (
+            allow_flash
+            and t >= 256
+            and t % 128 == 0
+            and jax.default_backend() == "tpu"  # interp-mode flash loses on CPU
+        ):
+            # long blocks: Pallas flash kernel (streams K/V through VMEM)
+            from predictionio_tpu.ops.flash_attention import flash_attention
+
+            a = flash_attention(heads(q), heads(k), heads(v), causal=True)
+        else:
+            a = full_attention(heads(q), heads(k), heads(v), causal=True)
         a = a.swapaxes(-3, -2).reshape(*y.shape)
         x = x + a @ layer["wo"]
         y = _layer_norm(x, layer["ln2"])
@@ -157,7 +174,7 @@ def _loss_fn(params, seq, cfg: SASRecConfig):
 
 @partial(jax.jit, static_argnums=(2,))
 def _predict_logits(params, seq, cfg: SASRecConfig):
-    hidden = _forward(params, seq, cfg)
+    hidden = _forward(params, seq, cfg, allow_flash=True)
     return hidden[:, -1, :] @ params["emb"][1:].T
 
 

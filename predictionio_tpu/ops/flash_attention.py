@@ -1,0 +1,149 @@
+"""Pallas flash attention: the on-chip kernel for long-context blocks.
+
+The long-context serving path (ring attention, ``parallel/ring.py``) computes
+dense (T_local × T_local) score blocks per device; past a few thousand
+positions that intermediate dominates VMEM/HBM traffic.  This module provides
+the classic flash-attention formulation as a Pallas TPU kernel: the grid is
+(q_blocks, k_blocks) with the K dimension iterated innermost, so each K/V
+**block** streams through VMEM while the (o, m, l) online-softmax
+accumulators persist in VMEM scratch across the K sweep — full K/V never
+resides on-chip, so context length is bounded by HBM, not VMEM.
+
+``flash_attention`` is numerically exact (float32 accumulators) and falls
+back to interpret mode off-TPU, so the CPU test mesh exercises the identical
+kernel code.  Callers dispatch explicitly (see the gate in
+``models/sequential.py``: dense attention off-TPU or for short blocks,
+``flash_attention`` for long blocks on TPU; no VJP yet, so training paths
+use the dense form).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# (sublane, lane)-friendly defaults; one Q×K score block fits VMEM easily
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, causal: bool,
+    scale: float, block_q: int, block_k: int
+):
+    qi = pl.program_id(0)
+    ki = pl.program_id(1)
+    n_k = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[...].astype(jnp.float32) * scale  # (block_q, d)
+    k = k_ref[...].astype(jnp.float32)  # (block_k, d) — this K block only
+    v = v_ref[...].astype(jnp.float32)
+    s = q @ k.T  # MXU
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m_prev, l_prev = m_ref[...], l_ref[...]
+    m_blk = jnp.max(s, axis=1)
+    m_new = jnp.maximum(m_prev, m_blk)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    m_ref[...] = m_new
+    l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1)
+    acc_ref[...] = acc_ref[...] * alpha[:, None] + p @ v
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[...] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)[:, None]
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret")
+)
+def _flash_2d(q, k, v, causal, scale, block_q, block_k, interpret):
+    t_q, d = q.shape
+    t_kv = k.shape[0]
+    grid = (t_q // block_q, t_kv // block_k)  # K innermost: accumulators carry
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, d), lambda qi, ki: (qi, 0)),
+            pl.BlockSpec((block_k, d), lambda qi, ki: (ki, 0)),
+            pl.BlockSpec((block_k, d), lambda qi, ki: (ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_q, d), lambda qi, ki: (qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((t_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = BLOCK_Q,
+    block_k: int = BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """Exact attention via the Pallas kernel. q/k/v: (..., T, D).
+
+    T must divide by the block sizes (pad beforehand for ragged lengths).
+    ``interpret`` defaults to True off-TPU so tests run the kernel anywhere.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    t_q, d = q.shape[-2], q.shape[-1]
+    t_kv = k.shape[-2]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_kv)
+    if t_q % block_q or t_kv % block_k:
+        raise ValueError(
+            f"sequence lengths ({t_q}, {t_kv}) must divide block sizes "
+            f"({block_q}, {block_k})"
+        )
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    fn = functools.partial(
+        _flash_2d,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    for _ in range(q.ndim - 2):
+        fn = jax.vmap(fn)
+    return fn(q, k, v)

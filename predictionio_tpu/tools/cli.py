@@ -435,6 +435,14 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_upgrade(args) -> int:
+    # parity: Console "upgrade" verb — storage schemas here are
+    # self-migrating (CREATE IF NOT EXISTS), so this is informational
+    print(f"[INFO] predictionio_tpu {__version__}: storage schemas are "
+          "current; nothing to upgrade.")
+    return 0
+
+
 def cmd_export(args) -> int:
     from predictionio_tpu.tools.export_import import export_events
 
@@ -557,6 +565,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ip", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=9000)
     sp.set_defaults(func=cmd_dashboard)
+
+    sub.add_parser("upgrade").set_defaults(func=cmd_upgrade)
 
     sp = sub.add_parser("template")
     t_sub = sp.add_subparsers(dest="template_command", required=True)
