@@ -343,6 +343,39 @@ class ALSScorer:
 
             self._score = _score
 
+    def recommend_batch(
+        self, user_indices: np.ndarray, num: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Unfiltered top-num for MANY users in one pass.
+
+        The evaluation hot loop (MetricEvaluator batch predict) scores
+        thousands of queries; one (B, rank)×(rank, n_items) matmul + top-k
+        replaces B scalar calls.  Returns (idx (B, k), scores (B, k)).
+        """
+        users = np.asarray(user_indices, np.int64)
+        k = min(max(num, 1), self.n_items)
+        if self.on_device and k <= self._k:
+            if not hasattr(self, "_score_batch"):
+
+                @jax.jit
+                def _score_batch(U, V, pad_mask, u_idx):
+                    scores = U[u_idx] @ V.T  # (B, pad)
+                    scores = jnp.where(pad_mask[None, :], -1e30, scores)
+                    return jax.lax.top_k(scores, self._k)
+
+                self._score_batch = _score_batch
+            vals, idx = self._score_batch(
+                self._U, self._V, self._pad_mask, jnp.asarray(users)
+            )
+            return np.asarray(idx)[:, :k], np.asarray(vals)[:, :k]
+        m = self.model
+        scores = m.user_factors[users] @ m.item_factors.T  # (B, n_items)
+        idx = np.argpartition(-scores, k - 1, axis=1)[:, :k]
+        row_scores = np.take_along_axis(scores, idx, axis=1)
+        order = np.argsort(-row_scores, axis=1)
+        idx = np.take_along_axis(idx, order, axis=1)
+        return idx, np.take_along_axis(row_scores, order, axis=1)
+
     def recommend(
         self,
         user_idx: int,

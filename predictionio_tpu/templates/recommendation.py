@@ -223,6 +223,36 @@ class ALSAlgorithm(Algorithm):
             self._scorers[id(model)] = scorer
         return scorer
 
+    def batch_predict(self, model: ALSModel, queries):
+        """Vectorized bulk predict for evaluation (BaseAlgorithm.batchPredict
+        parity): filter-free known-user queries score in ONE device pass;
+        the rest fall back to per-query predict."""
+        simple, fallback = [], []
+        for i, q in queries:
+            u = model.user_map.get(q.user)
+            if u is not None and not q.blackList and not q.whiteList:
+                simple.append((i, int(u), q.num))
+            else:
+                fallback.append((i, q))
+        by_index = dict(super().batch_predict(model, fallback)) if fallback else {}
+        if simple:
+            # width from the batched queries only: a fallback query's num
+            # must not push the batch off the compiled top-k path
+            num = max(n for _, _, n in simple)
+            idx, scores = self._scorer(model).recommend_batch(
+                np.asarray([u for _, u, _ in simple]), num
+            )
+            inv = model.item_map.inverse
+            for row, (i, _, n) in enumerate(simple):
+                by_index[i] = PredictedResult(
+                    itemScores=[
+                        ItemScore(item=inv[int(j)], score=float(s))
+                        for j, s in zip(idx[row][:n], scores[row][:n])
+                        if s > -1e29
+                    ]
+                )
+        return list(by_index.items())
+
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         user_idx = model.user_map.get(query.user)
         if user_idx is None:

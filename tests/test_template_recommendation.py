@@ -143,6 +143,28 @@ def test_failed_train_marks_instance_aborted(storage):
         store_mod.set_storage(None)
 
 
+def test_batch_predict_matches_per_query(app_with_events):
+    storage = app_with_events
+    engine = RecommendationEngine.apply()
+    ep = engine.params_from_variant(VARIANT)
+    ctx = MeshContext.create()
+    algo = engine.make_algorithms(ep)[0]
+    model = engine.train(ctx, ep, algorithms=[algo])[0]
+    queries = [
+        (0, Query(user="u1", num=3)),
+        (1, Query(user="u2", num=2)),
+        (2, Query(user="nobody", num=3)),  # unknown → fallback path
+        (3, Query(user="u3", num=2, blackList=["i0"])),  # filtered → fallback
+    ]
+    batch = dict(algo.batch_predict(model, queries))
+    assert set(batch) == {0, 1, 2, 3}
+    for i, q in queries:
+        single = algo.predict(model, q)
+        got = [(s.item, round(s.score, 4)) for s in batch[i].itemScores]
+        want = [(s.item, round(s.score, 4)) for s in single.itemScores]
+        assert got == want, f"query {i} diverged"
+
+
 def test_eval_read_folds(app_with_events):
     engine = RecommendationEngine.apply()
     variant = dict(VARIANT)
