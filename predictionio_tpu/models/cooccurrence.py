@@ -3,14 +3,16 @@
 Capability parity with ``examples/scala-parallel-similarproduct/
 multi-events-multi-algos/src/main/scala/CooccurrenceAlgorithm.scala:45-140``
 (user-item self-join → per-pair counts → top-N per item) and, via
-:func:`llr_scores`, the log-likelihood-ratio scoring at the heart of CCO /
-Universal Recommender.
+:func:`llr_scores` / :func:`llr_cross_scores`, the log-likelihood-ratio
+scoring at the heart of CCO / Universal Recommender.
 
 TPU-first design: the reference's RDD self-join is a shuffle of all
 (item, item) pairs per user.  Here the user×item incidence matrix is built
-densely in user blocks and the co-occurrence matrix is accumulated as
-``C = Σ_blocks A_bᵀ A_b`` — a chain of MXU matmuls under ``lax.scan``, no
-pair explosion.  Top-N per row via ``lax.top_k``.
+densely in user blocks and (co/cross-)occurrence is accumulated as
+``C = Σ_blocks A_bᵀ B_b`` — a chain of MXU matmuls under ``lax.scan``, no
+pair explosion.  ``cooccurrence_matrix`` is the self-case
+(``cross_occurrence_matrix(x, x)``); everything shares one blocking helper
+so the incidence/scan code exists once.  Top-N per row via ``lax.top_k``.
 """
 
 from __future__ import annotations
@@ -43,71 +45,134 @@ class CooccurrenceModel:
         return idx[keep], sc[keep]
 
 
-def cooccurrence_matrix(ctx: MeshContext, interactions: Interactions) -> jnp.ndarray:
-    """Dense (n_items, n_items) co-occurrence counts (diagonal = item counts)."""
-    n_users = interactions.n_users
-    n_items = interactions.n_items
-    n_items_pad = pad_to_multiple(n_items, 128)  # lane-aligned for the MXU
-    n_users_pad = pad_to_multiple(n_users, _USER_BLOCK)
-    # binary incidence built on host block-by-block is memory-hungry; build
-    # sparse→dense per block on device instead via scatter
+@dataclasses.dataclass
+class BlockedIncidence:
+    """Host-blocked (user, item) pairs ready for the per-block scatter.
+
+    Build once with :func:`block_incidence` and reuse across matmuls (the
+    Universal Recommender re-uses the primary side for every indicator).
+    """
+
+    local_user: np.ndarray  # (n_blocks, width) int32
+    item: np.ndarray  # (n_blocks, width) int32
+    mask: np.ndarray  # (n_blocks, width) float32
+    n_blocks: int
+
+
+def block_incidence(inter: Interactions, n_users_pad: int) -> BlockedIncidence:
     n_blocks = n_users_pad // _USER_BLOCK
-
-    order = np.argsort(interactions.user, kind="stable")
-    u = interactions.user[order].astype(np.int64)
-    i = interactions.item[order].astype(np.int64)
-
-    # row pointer per block
+    order = np.argsort(inter.user, kind="stable")
+    u = inter.user[order].astype(np.int64)
+    i = inter.item[order].astype(np.int64)
     block_of = u // _USER_BLOCK
     counts = np.bincount(block_of, minlength=n_blocks)
-    max_per_block = pad_to_multiple(int(counts.max()) if len(counts) else 1, 8)
-    lu = np.zeros((n_blocks, max_per_block), np.int32)
-    li = np.zeros((n_blocks, max_per_block), np.int32)
-    lm = np.zeros((n_blocks, max_per_block), np.float32)
+    width = pad_to_multiple(int(counts.max()) if len(counts) else 1, 8)
+    lu = np.zeros((n_blocks, width), np.int32)
+    li = np.zeros((n_blocks, width), np.int32)
+    lm = np.zeros((n_blocks, width), np.float32)
     offsets = np.concatenate([[0], np.cumsum(counts)])
     for b in range(n_blocks):
         s, e = offsets[b], offsets[b + 1]
-        n = e - s
-        lu[b, :n] = (u[s:e] - b * _USER_BLOCK).astype(np.int32)
-        li[b, :n] = i[s:e].astype(np.int32)
-        lm[b, :n] = 1.0
+        lu[b, : e - s] = (u[s:e] - b * _USER_BLOCK).astype(np.int32)
+        li[b, : e - s] = i[s:e].astype(np.int32)
+        lm[b, : e - s] = 1.0
+    return BlockedIncidence(local_user=lu, item=li, mask=lm, n_blocks=n_blocks)
+
+
+def distinct_item_counts(inter: Interactions, n_items: int) -> np.ndarray:
+    """Per-item count of DISTINCT users (LLR marginals must match the
+    binarized incidence, not raw event counts)."""
+    pairs = inter.user.astype(np.int64) * n_items + inter.item.astype(np.int64)
+    uniq_items = (np.unique(pairs) % n_items).astype(np.int64)
+    return np.bincount(uniq_items, minlength=n_items).astype(np.float32)
+
+
+def cross_occurrence_matrix(
+    ctx: MeshContext,
+    primary: "Interactions | BlockedIncidence",
+    secondary: "Interactions | BlockedIncidence",
+    n_items_primary: int,
+    n_items_secondary: int,
+    n_users_pad: Optional[int] = None,
+) -> jnp.ndarray:
+    """Dense (primary_items, secondary_items) CROSS-occurrence counts.
+
+    The CCO / Universal Recommender core: #distinct users who did the PRIMARY
+    event on item i AND the SECONDARY event on item j (``C = A_pᵀ A_s`` with
+    binarized incidence over a shared user axis).  Either side may be passed
+    pre-blocked (:func:`block_incidence`) to amortize host work across calls;
+    if so, ``n_users_pad`` used for blocking must match.
+    """
+    if n_users_pad is None:
+        n_users = max(
+            x.n_users
+            for x in (primary, secondary)
+            if isinstance(x, Interactions)
+        )
+        n_users_pad = pad_to_multiple(n_users, _USER_BLOCK)
+    p_pad = pad_to_multiple(n_items_primary, 128)  # lane-aligned for the MXU
+    s_pad = pad_to_multiple(n_items_secondary, 128)
+    if isinstance(primary, Interactions):
+        primary = block_incidence(primary, n_users_pad)
+    if isinstance(secondary, Interactions):
+        secondary = block_incidence(secondary, n_users_pad)
 
     @jax.jit
-    def accumulate(lu, li, lm):
+    def accumulate(pu, pi, pm, su, si, sm):
         def body(C, xs):
-            bu, bi, bm = xs
-            A = jnp.zeros((_USER_BLOCK, n_items_pad), jnp.bfloat16)
-            A = A.at[bu, bi].max(bm.astype(jnp.bfloat16))  # binary incidence
-            C = C + jnp.dot(
-                A.T, A, preferred_element_type=jnp.float32
-            )  # MXU matmul
-            return C, None
+            bpu, bpi, bpm, bsu, bsi, bsm = xs
+            # sparse→dense per block on device via scatter; binarized (max)
+            A_p = jnp.zeros((_USER_BLOCK, p_pad), jnp.bfloat16)
+            A_p = A_p.at[bpu, bpi].max(bpm.astype(jnp.bfloat16))
+            A_s = jnp.zeros((_USER_BLOCK, s_pad), jnp.bfloat16)
+            A_s = A_s.at[bsu, bsi].max(bsm.astype(jnp.bfloat16))
+            return C + jnp.dot(A_p.T, A_s, preferred_element_type=jnp.float32), None
 
-        C0 = jnp.zeros((n_items_pad, n_items_pad), jnp.float32)
-        C, _ = jax.lax.scan(body, C0, (lu, li, lm))
+        C0 = jnp.zeros((p_pad, s_pad), jnp.float32)
+        C, _ = jax.lax.scan(body, C0, (pu, pi, pm, su, si, sm))
         return C
 
-    C = accumulate(jnp.asarray(lu), jnp.asarray(li), jnp.asarray(lm))
-    return C[:n_items, :n_items]
+    C = accumulate(
+        jnp.asarray(primary.local_user),
+        jnp.asarray(primary.item),
+        jnp.asarray(primary.mask),
+        jnp.asarray(secondary.local_user),
+        jnp.asarray(secondary.item),
+        jnp.asarray(secondary.mask),
+    )
+    return C[:n_items_primary, :n_items_secondary]
 
 
-def llr_scores(C: jnp.ndarray, n_users: Optional[int] = None) -> jnp.ndarray:
-    """Log-likelihood-ratio rescoring of a co-occurrence matrix (CCO/UR).
-
-    Contingency per pair over the USER population (Mahout/CCO convention):
-    k11 = C_ij, k12 = count_i - C_ij, k21 = count_j - C_ij,
-    k22 = n_users - count_i - count_j + C_ij.
-    Pass ``n_users``; without it the interaction total is a (biased) stand-in.
-    """
-    diag = jnp.diag(C)
-    total = jnp.maximum(
-        jnp.float32(n_users) if n_users is not None else diag.sum(), 1.0
+def cooccurrence_matrix(ctx: MeshContext, interactions: Interactions) -> jnp.ndarray:
+    """Dense (n_items, n_items) co-occurrence counts (diagonal = item counts);
+    the self-case of :func:`cross_occurrence_matrix`."""
+    n_items = interactions.n_items
+    n_users_pad = pad_to_multiple(interactions.n_users, _USER_BLOCK)
+    blocked = block_incidence(interactions, n_users_pad)
+    return cross_occurrence_matrix(
+        ctx, blocked, blocked, n_items, n_items, n_users_pad=n_users_pad
     )
 
+
+def llr_cross_scores(
+    C: jnp.ndarray,
+    primary_counts: jnp.ndarray,
+    secondary_counts: jnp.ndarray,
+    n_users: int,
+) -> jnp.ndarray:
+    """Dunning G² over a (cross-)occurrence table.
+
+    Marginals MUST be distinct-user counts (:func:`distinct_item_counts`) so
+    the contingency table is consistent with the binarized incidence.
+    """
     k11 = C
-    k12 = jnp.maximum(diag[:, None] - C, 0.0)
-    k21 = jnp.maximum(diag[None, :] - C, 0.0)
-    k22 = jnp.maximum(total - diag[:, None] - diag[None, :] + C, 0.0)
+    k12 = jnp.maximum(primary_counts[:, None] - C, 0.0)
+    k21 = jnp.maximum(secondary_counts[None, :] - C, 0.0)
+    total = jnp.asarray(n_users, jnp.float32)
+    k22 = jnp.maximum(
+        total - primary_counts[:, None] - secondary_counts[None, :] + C,
+        0.0,
+    )
 
     def xlogx(x):
         return jnp.where(x > 0, x * jnp.log(x), 0.0)
@@ -122,6 +187,15 @@ def llr_scores(C: jnp.ndarray, n_users: Optional[int] = None) -> jnp.ndarray:
     # Dunning's G²: 2·(rowEntropy + colEntropy − matrixEntropy), floored at 0
     llr = 2.0 * jnp.maximum(h_rows + h_cols - h_matrix, 0.0)
     return jnp.where(C > 0, llr, 0.0)
+
+
+def llr_scores(C: jnp.ndarray, n_users: Optional[int] = None) -> jnp.ndarray:
+    """LLR rescoring of a SELF co-occurrence matrix: marginals come from the
+    diagonal (= distinct users per item).  Pass ``n_users``; without it the
+    interaction total is a (biased) stand-in."""
+    diag = jnp.diag(C)
+    total = jnp.float32(n_users) if n_users is not None else diag.sum()
+    return llr_cross_scores(C, diag, diag, jnp.maximum(total, 1.0))
 
 
 def train_cooccurrence(

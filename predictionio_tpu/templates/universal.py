@@ -1,0 +1,230 @@
+"""Universal-Recommender engine template: CCO over multiple event types.
+
+Capability parity with the Universal Recommender workload the reference
+ecosystem runs (BASELINE.md: "Universal Recommender — CCO multi-event,
+MovieLens-25M"): one PRIMARY event (e.g. ``buy``) plus secondary indicator
+events (``view``, ``like``, …).  Per indicator, a CROSS-occurrence matrix
+between the primary event and that indicator is computed
+(``C_t = A_primaryᵀ A_t`` over the shared user axis — blocked MXU matmuls,
+:func:`predictionio_tpu.models.cooccurrence.cross_occurrence_matrix`),
+LLR-rescored over the user population, and truncated to top-N correlated
+items per row.
+
+At query time the user's RECENT history per event type is read live from the
+event store; each history item votes through its indicator's correlated-item
+rows and votes are summed — so new events shift recommendations without
+retraining (the reference UR's Elasticsearch-query-time behavior).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from collections import defaultdict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+)
+from predictionio_tpu.core.controller import SanityCheck
+from predictionio_tpu.data.batch import Interactions
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import LEventStore, PEventStore
+from predictionio_tpu.models.cooccurrence import (
+    _USER_BLOCK,
+    block_incidence,
+    cross_occurrence_matrix,
+    distinct_item_counts,
+    llr_cross_scores,
+)
+from predictionio_tpu.parallel.mesh import pad_to_multiple
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Query:
+    user: str
+    num: int = 10
+    blackList: Optional[list[str]] = None
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    itemScores: list[ItemScore]
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    per_event: dict  # event name → Interactions (shared user/item maps)
+    user_map: BiMap
+    item_map: BiMap
+    primary_event: str
+
+    def sanity_check(self):
+        primary = self.per_event.get(self.primary_event)
+        if primary is None or len(primary) == 0:
+            raise ValueError(
+                f"no {self.primary_event!r} (primary) events found; check appName"
+            )
+
+
+@dataclasses.dataclass
+class URDataSourceParams(Params):
+    appName: str = "default"
+    eventNames: tuple = ("buy", "view")  # first is the primary event
+
+
+class URDataSource(DataSource):
+    params_cls = URDataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        # one store scan for ALL event types, split per name afterwards
+        batch = PEventStore.find(
+            self.params.appName,
+            entity_type="user",
+            event_names=list(self.params.eventNames),
+            target_entity_type="item",
+        )
+        # shared id spaces across ALL event types
+        user_map = BiMap.string_int(batch.entity_id)
+        item_map = BiMap.string_int(
+            t for t in batch.target_entity_id if t is not None
+        )
+        per_event = {
+            name: batch.filter_events([name]).interactions(
+                user_map=user_map, item_map=item_map
+            )
+            for name in self.params.eventNames
+        }
+        return TrainingData(
+            per_event=per_event,
+            user_map=user_map,
+            item_map=item_map,
+            primary_event=self.params.eventNames[0],
+        )
+
+
+@dataclasses.dataclass
+class URAlgorithmParams(Params):
+    appName: str = "default"
+    maxCorrelatorsPerItem: int = 50  # top-N per indicator row (UR default)
+    maxQueryEvents: int = 100  # history depth read per event type at query
+
+
+@dataclasses.dataclass
+class URModel:
+    # event name → (top_items (n_items, N) int32, top_scores (n_items, N) f32)
+    indicators: dict
+    item_map: BiMap
+    primary_event: str
+
+
+class URAlgorithm(Algorithm):
+    params_cls = URAlgorithmParams
+
+    def train(self, ctx, pd: TrainingData) -> URModel:
+        primary = pd.per_event[pd.primary_event]
+        n_items = len(pd.item_map)
+        n_users = len(pd.user_map)
+        n_users_pad = pad_to_multiple(n_users, _USER_BLOCK)
+        # block the primary side ONCE; reused for every indicator matmul
+        primary_blocked = block_incidence(primary, n_users_pad)
+        # LLR marginals = DISTINCT-user counts, matching binarized incidence
+        primary_counts = jnp.asarray(distinct_item_counts(primary, n_items))
+        indicators = {}
+        for name, inter in pd.per_event.items():
+            if len(inter) == 0:
+                logger.warning("indicator %s has no events; skipped", name)
+                continue
+            C = cross_occurrence_matrix(
+                ctx, primary_blocked, inter, n_items, n_items,
+                n_users_pad=n_users_pad,
+            )
+            counts_t = jnp.asarray(distinct_item_counts(inter, n_items))
+            llr = llr_cross_scores(C, primary_counts, counts_t, n_users)
+            if name == pd.primary_event:
+                llr = llr - jnp.diag(jnp.diag(llr))  # self-pairs excluded
+            k = min(self.params.maxCorrelatorsPerItem, n_items)
+            vals, idx = jax.lax.top_k(llr.T, k)  # row per INDICATOR item
+            indicators[name] = (
+                np.asarray(idx, np.int32),
+                np.asarray(vals, np.float32),
+            )
+        return URModel(
+            indicators=indicators,
+            item_map=pd.item_map,
+            primary_event=pd.primary_event,
+        )
+
+    def _history(self, user: str, event_name: str) -> list[str]:
+        try:
+            events = LEventStore.find_by_entity(
+                self.params.appName,
+                entity_type="user",
+                entity_id=user,
+                event_names=[event_name],
+                target_entity_type="item",
+                limit=self.params.maxQueryEvents,
+                latest=True,
+            )
+            return [e.target_entity_id for e in events if e.target_entity_id]
+        except Exception:
+            logger.exception("history lookup failed (%s, %s)", user, event_name)
+            return []
+
+    def predict(self, model: URModel, query: Query) -> PredictedResult:
+        scores: dict[int, float] = defaultdict(float)
+        primary_seen: set[int] = set()
+        for event_name, (top_items, top_scores) in model.indicators.items():
+            for item_id in self._history(query.user, event_name):
+                j = model.item_map.get(item_id)
+                if j is None:
+                    continue
+                if event_name == model.primary_event:
+                    primary_seen.add(int(j))
+                for corr, s in zip(top_items[j], top_scores[j]):
+                    if s > 0:
+                        scores[int(corr)] += float(s)
+        # UR default: only the PRIMARY event's items are blacklisted — a
+        # viewed-but-never-bought item remains recommendable
+        for j in primary_seen:
+            scores.pop(j, None)
+        if query.blackList:
+            for item_id in query.blackList:
+                j = model.item_map.get(item_id)
+                if j is not None:
+                    scores.pop(int(j), None)
+        top = sorted(scores.items(), key=lambda kv: -kv[1])[: query.num]
+        inv = model.item_map.inverse
+        return PredictedResult(
+            itemScores=[ItemScore(inv[j], s) for j, s in top]
+        )
+
+
+class UniversalRecommenderEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source_cls=URDataSource,
+            preparator_cls=IdentityPreparator,
+            algorithm_cls_map={"ur": URAlgorithm},
+            serving_cls=FirstServing,
+            query_cls=Query,
+        )

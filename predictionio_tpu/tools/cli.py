@@ -94,6 +94,7 @@ BUILTIN_TEMPLATES = {
         "predictionio_tpu.templates.sequentialrecommendation."
         "SequentialRecommendationEngine"
     ),
+    "universalrecommender": "predictionio_tpu.templates.universal.UniversalRecommenderEngine",
     "python": "predictionio_tpu.pypio.PythonEngine",
 }
 

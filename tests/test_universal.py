@@ -1,0 +1,153 @@
+"""Universal Recommender (CCO multi-event) template tests."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import Event
+from predictionio_tpu.data import store as store_mod
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.models.cooccurrence import (
+    cross_occurrence_matrix,
+    llr_cross_scores,
+)
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create()
+
+
+class TestCrossOccurrence:
+    def test_counts_match_bruteforce(self, ctx):
+        from predictionio_tpu.data.batch import Interactions
+        from predictionio_tpu.data.bimap import BiMap
+
+        def inter(rows, n_u, n_i):
+            u, i = map(np.array, zip(*rows))
+            return Interactions(
+                u.astype(np.int32), i.astype(np.int32),
+                np.ones(len(rows), np.float32), np.zeros(len(rows)),
+                BiMap.string_int(f"u{k}" for k in range(n_u)),
+                BiMap.string_int(f"i{k}" for k in range(n_i)),
+            )
+
+        # user 0 bought i0 and viewed i1,i2; user 1 bought i0,i1, viewed i2
+        primary = inter([(0, 0), (1, 0), (1, 1)], 2, 3)
+        secondary = inter([(0, 1), (0, 2), (1, 2)], 2, 3)
+        C = np.asarray(cross_occurrence_matrix(ctx, primary, secondary, 3, 3))
+        # C[p, s] = #users who bought p AND viewed s
+        assert C[0, 1] == 1  # u0 bought i0, viewed i1
+        assert C[0, 2] == 2  # u0 and u1 both bought i0 and viewed i2
+        assert C[1, 2] == 1  # u1
+        assert C[2, 2] == 0
+
+    def test_llr_cross_nonsquare(self, ctx):
+        import jax.numpy as jnp
+
+        C = jnp.asarray(np.array([[5.0, 0.0], [1.0, 3.0], [0.0, 0.0]], np.float32))
+        llr = np.asarray(
+            llr_cross_scores(
+                C,
+                primary_counts=jnp.asarray(np.array([5.0, 4.0, 2.0], np.float32)),
+                secondary_counts=jnp.asarray(np.array([6.0, 3.0], np.float32)),
+                n_users=20,
+            )
+        )
+        assert llr.shape == (3, 2)
+        assert llr[0, 0] > 0 and llr[1, 1] > 0
+        assert llr[0, 1] == 0 and llr[2, 0] == 0  # zero co-occurrence → 0
+
+
+@pytest.fixture()
+def seeded(storage):
+    store_mod.set_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(App(0, "urapp"))
+    le = storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(4)
+    # two taste groups (10 items each); buys are sparse, views are denser —
+    # the UR's point is that view behavior sharpens buy recommendations.
+    # Histories stay small relative to the group so recommendations exist.
+    for u in range(60):
+        group = u % 2
+        items = list(range(0, 10)) if group == 0 else list(range(10, 20))
+        for i in rng.choice(items, size=3, replace=False):
+            le.insert(
+                Event(event="view", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}"),
+                app_id,
+            )
+        le.insert(
+            Event(event="buy", entity_type="user", entity_id=f"u{u}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{items[u % len(items)]}"),
+            app_id,
+        )
+    yield storage
+    store_mod.set_storage(None)
+
+
+class TestURTemplate:
+    def test_end_to_end(self, seeded, ctx):
+        from predictionio_tpu.templates.universal import (
+            Query,
+            UniversalRecommenderEngine,
+        )
+
+        engine = UniversalRecommenderEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {
+                    "params": {"appName": "urapp", "eventNames": ["buy", "view"]}
+                },
+                "algorithms": [
+                    {
+                        "name": "ur",
+                        "params": {
+                            "appName": "urapp",
+                            "maxCorrelatorsPerItem": 6,
+                        },
+                    }
+                ],
+            }
+        )
+        models = engine.train(ctx, ep)
+        algo = engine.make_algorithms(ep)[0]
+        res = algo.predict(models[0], Query(user="u0", num=4))
+        assert res.itemScores
+        # group-0 user gets group-0 recommendations
+        in_group = sum(1 for s in res.itemScores if int(s.item[1:]) < 10)
+        assert in_group == len(res.itemScores)
+        # only the PRIMARY (buy) history is excluded; viewed-but-not-bought
+        # items remain recommendable (UR default semantics)
+        from predictionio_tpu.data.store import LEventStore
+
+        bought = {
+            e.target_entity_id
+            for e in LEventStore.find_by_entity(
+                "urapp", "user", "u0", event_names=["buy"]
+            )
+        }
+        assert not bought & {s.item for s in res.itemScores}
+        # blacklist respected
+        top = res.itemScores[0].item
+        res_bl = algo.predict(models[0], Query(user="u0", num=4, blackList=[top]))
+        assert top not in {s.item for s in res_bl.itemScores}
+        # user with no history → empty
+        assert algo.predict(models[0], Query(user="ghost", num=3)).itemScores == []
+
+    def test_missing_primary_rejected(self, seeded, ctx):
+        from predictionio_tpu.templates.universal import UniversalRecommenderEngine
+
+        engine = UniversalRecommenderEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {
+                    "params": {"appName": "urapp", "eventNames": ["purchase"]}
+                },
+                "algorithms": [{"name": "ur", "params": {"appName": "urapp"}}],
+            }
+        )
+        with pytest.raises(ValueError, match="primary"):
+            engine.train(ctx, ep)
