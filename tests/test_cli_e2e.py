@@ -1,0 +1,163 @@
+"""Tier-3 end-to-end lifecycle test: real CLI processes + real HTTP.
+
+Parity: tests/pio_tests/scenarios/quickstart_test.py (SURVEY.md §4 tier 3) —
+app new → eventserver → REST import → train → deploy → query → undeploy,
+each phase through the actual operator surface (subprocesses + sockets).
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def http(method, url, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method, headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def wait_alive(url, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            status, _ = http("GET", url, timeout=2)
+            if status == 200:
+                return
+        except Exception:
+            time.sleep(0.3)
+    raise TimeoutError(f"{url} never came alive")
+
+
+@pytest.fixture()
+def cli_ctx(tmp_path):
+    env = dict(os.environ)
+    env.update(
+        {
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.sqlite"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        }
+    )
+    procs = []
+
+    def pio(*args, background=False):
+        cmd = [sys.executable, "-m", "predictionio_tpu.tools.cli", *args]
+        if background:
+            p = subprocess.Popen(
+                cmd, env=env, cwd=str(tmp_path),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            procs.append(p)
+            return p
+        return subprocess.run(
+            cmd, env=env, cwd=str(tmp_path), capture_output=True, text=True,
+            timeout=300,
+        )
+
+    yield {"pio": pio, "tmp": tmp_path}
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.slow
+def test_quickstart_lifecycle(cli_ctx):
+    pio, tmp = cli_ctx["pio"], cli_ctx["tmp"]
+
+    out = pio("app", "new", "qs")
+    assert out.returncode == 0, out.stderr
+    key = re.search(r"Access Key: (\S+)", out.stdout).group(1)
+
+    es_port = free_port()
+    pio("eventserver", "--ip", "127.0.0.1", "--port", str(es_port),
+        background=True)
+    wait_alive(f"http://127.0.0.1:{es_port}/")
+
+    rng = np.random.default_rng(0)
+    events = [
+        {
+            "event": "rate",
+            "entityType": "user",
+            "entityId": f"u{u}",
+            "targetEntityType": "item",
+            "targetEntityId": f"i{int(i)}",
+            "properties": {"rating": float(rng.integers(1, 6))},
+        }
+        for u in range(25)
+        for i in rng.choice(15, 5, replace=False)
+    ]
+    for start in range(0, len(events), 50):
+        status, results = http(
+            "POST",
+            f"http://127.0.0.1:{es_port}/batch/events.json?accessKey={key}",
+            events[start : start + 50],
+        )
+        assert status == 200
+        assert all(r["status"] == 201 for r in results)
+
+    variant = {
+        "id": "default",
+        "engineFactory": (
+            "predictionio_tpu.templates.recommendation.RecommendationEngine"
+        ),
+        "datasource": {"params": {"appName": "qs"}},
+        "algorithms": [
+            {"name": "als", "params": {"rank": 4, "numIterations": 3}}
+        ],
+    }
+    (tmp / "engine.json").write_text(json.dumps(variant))
+
+    assert pio("build").returncode == 0
+    out = pio("train")
+    assert out.returncode == 0 and "Training completed" in out.stdout, out.stderr
+
+    qs_port = free_port()
+    pio("deploy", "--ip", "127.0.0.1", "--port", str(qs_port), background=True)
+    wait_alive(f"http://127.0.0.1:{qs_port}/")
+
+    status, res = http(
+        "POST", f"http://127.0.0.1:{qs_port}/queries.json", {"user": "u1", "num": 3}
+    )
+    assert status == 200 and len(res["itemScores"]) == 3
+
+    out = pio("undeploy", "--ip", "127.0.0.1", "--port", str(qs_port))
+    assert out.returncode == 0
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            http("GET", f"http://127.0.0.1:{qs_port}/", timeout=1)
+            time.sleep(0.2)
+        except Exception:
+            break
+    else:
+        pytest.fail("query server still alive after undeploy")
