@@ -246,7 +246,11 @@ class QueryServer:
 
         @svc.route("POST", r"/stop")
         def stop_route(req: Request):
-            threading.Thread(target=self.service.stop, daemon=True).start()
+            def _stop():
+                time.sleep(0.3)  # let the response flush before the socket dies
+                self.service.stop()
+
+            threading.Thread(target=_stop, daemon=True).start()
             return json_response(200, {"message": "Shutting down."})
 
         @svc.route("GET", r"/plugins\.json")

@@ -321,6 +321,7 @@ def cmd_deploy(args) -> int:
 
 
 def cmd_undeploy(args) -> int:
+    import http.client
     import urllib.request
 
     url = f"http://{args.ip}:{args.port}/stop"
@@ -329,6 +330,11 @@ def cmd_undeploy(args) -> int:
             urllib.request.Request(url, method="POST"), timeout=5
         ) as r:
             print(f"[INFO] {r.read().decode()}")
+        return 0
+    except (http.client.RemoteDisconnected, ConnectionResetError):
+        # the server can tear the socket down mid-response while shutting
+        # down — the stop still happened
+        print("[INFO] Server stopped.")
         return 0
     except Exception as e:
         return _die(f"Undeploy failed: {e}")

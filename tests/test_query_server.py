@@ -117,9 +117,15 @@ class TestQueryServer:
         finally:
             status, body = call("POST", base + "/stop")
             assert "Shutting down" in body["message"]
-            time.sleep(0.2)
-            with pytest.raises(Exception):
-                call("GET", base + "/")
+            deadline = time.time() + 5  # /stop delays ~0.3s to flush response
+            while time.time() < deadline:
+                try:
+                    call("GET", base + "/")
+                    time.sleep(0.1)
+                except Exception:
+                    break
+            else:
+                pytest.fail("server still alive after /stop")
 
     def test_output_blocker_plugin_and_plugins_route(self, trained):
         qs = QueryServer(
