@@ -79,7 +79,16 @@ class HttpService:
         return json_response(404, {"message": "not found"})
 
     # -- server lifecycle ---------------------------------------------------
-    def start(self, host: str = "0.0.0.0", port: int = 7070) -> int:
+    def start(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 7070,
+        cert_path: Optional[str] = None,
+        key_path: Optional[str] = None,
+    ) -> int:
+        """Start serving; TLS when cert/key paths are given (parity:
+        common SSLConfiguration — the reference servers optionally serve
+        HTTPS from a configured keystore)."""
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -142,6 +151,14 @@ class HttpService:
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
+        if cert_path:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert_path, key_path)
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True
+            )
         actual_port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, name=f"{self.name}-http", daemon=True

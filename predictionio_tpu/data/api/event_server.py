@@ -308,8 +308,8 @@ class EventServer:
             return json_response(200, {"message": "Ok"})
 
     # -- lifecycle -----------------------------------------------------------
-    def start(self, host: str = "0.0.0.0", port: int = 7070) -> int:
-        actual = self.service.start(host, port)
+    def start(self, host: str = "0.0.0.0", port: int = 7070, **tls) -> int:
+        actual = self.service.start(host, port, **tls)
         logger.info("event server listening on %s:%s", host, actual)
         return actual
 

@@ -274,8 +274,8 @@ class QueryServer:
             )
 
     # -- lifecycle ---------------------------------------------------------------
-    def start(self, host: str = "0.0.0.0", port: int = 8000) -> int:
-        actual = self.service.start(host, port)
+    def start(self, host: str = "0.0.0.0", port: int = 8000, **tls) -> int:
+        actual = self.service.start(host, port, **tls)
         logger.info("query server listening on %s:%s", host, actual)
         return actual
 

@@ -310,7 +310,8 @@ def cmd_deploy(args) -> int:
         access_key=args.accesskey,
         plugins=load_plugins(args.plugin),
     )
-    port = qs.start(args.ip, args.port)
+    port = qs.start(args.ip, args.port, cert_path=args.cert_path,
+                    key_path=args.key_path)
     print(f"[INFO] Engine is deployed and running. Engine API is live at "
           f"http://{args.ip}:{port}.")
     try:
@@ -366,7 +367,8 @@ def cmd_eventserver(args) -> int:
     es = EventServer(
         storage=_storage(), stats=args.stats, plugins=load_plugins(args.plugin)
     )
-    port = es.start(args.ip, args.port)
+    port = es.start(args.ip, args.port, cert_path=args.cert_path,
+                    key_path=args.key_path)
     print(f"[INFO] Event Server is listening at http://{args.ip}:{port}")
     try:
         es.service.serve_forever()
@@ -543,6 +545,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--event-server-port", type=int, default=7070)
     sp.add_argument("--accesskey", default=None)
     sp.add_argument("--plugin", action="append", default=[])
+    sp.add_argument("--cert-path", default=None)
+    sp.add_argument("--key-path", default=None)
     sp.set_defaults(func=cmd_deploy)
 
     sp = sub.add_parser("undeploy")
@@ -561,6 +565,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--port", type=int, default=7070)
     sp.add_argument("--stats", action="store_true")
     sp.add_argument("--plugin", action="append", default=[])
+    sp.add_argument("--cert-path", default=None)
+    sp.add_argument("--key-path", default=None)
     sp.set_defaults(func=cmd_eventserver)
 
     sp = sub.add_parser("adminserver")
