@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -18,7 +20,35 @@ import numpy as np
 NORTH_STAR_EVENTS_PER_SEC_PER_CHIP = 25_000_000 * 20 / (60 * 16)
 
 
+def _device_backend_alive(timeout_s: int = 120) -> bool:
+    """Probe device init in a SUBPROCESS: the axon TPU tunnel can hang
+    jax.devices() indefinitely; a hung probe must not hang the bench."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
+    if not _device_backend_alive():
+        print(
+            "WARNING: device backend unresponsive; benchmarking on CPU",
+            file=sys.stderr,
+        )
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        # CPU cannot chew 25M ratings in reasonable time; shrink unless set
+        os.environ.setdefault("BENCH_RATINGS", "1000000")
+        os.environ.setdefault("BENCH_ITERS", "3")
+        os.environ.setdefault("BENCH_USERS", "50000")
+        os.environ.setdefault("BENCH_ITEMS", "10000")
     import jax
 
     from predictionio_tpu.data.batch import Interactions

@@ -117,6 +117,28 @@ class EventBatch:
             np.fromiter((e in names_set for e in self.event), dtype=bool, count=len(self))
         )
 
+    def to_dataframe(self):
+        """Events as a pandas DataFrame (parity: data/view DataView and
+        PPythonEventStore's DataFrame-returning reads — the notebook
+        surface)."""
+        import pandas as pd
+
+        return pd.DataFrame(
+            {
+                "eventId": self.event_id,
+                "event": self.event,
+                "entityType": self.entity_type,
+                "entityId": self.entity_id,
+                "targetEntityType": self.target_entity_type,
+                "targetEntityId": self.target_entity_id,
+                "properties": self.properties,
+                "eventTime": pd.to_datetime(self.event_time, unit="s", utc=True),
+                "creationTime": pd.to_datetime(
+                    self.creation_time, unit="s", utc=True
+                ),
+            }
+        )
+
     # Id-index helpers ------------------------------------------------------
     def entity_bimap(self) -> BiMap[str, int]:
         return BiMap.string_int(self.entity_id)

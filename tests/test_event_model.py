@@ -130,6 +130,13 @@ class TestEventBatch:
         # u0 rated i0 with 0.0 at t=0
         assert inter.rating[0] == 0.0
 
+    def test_to_dataframe(self):
+        events = [ev("rate", "u1", {"rating": 4.0}, t=1, target="i1")]
+        df = EventBatch.from_events(events).to_dataframe()
+        assert list(df["event"]) == ["rate"]
+        assert df["eventTime"].dt.year.iloc[0] == 2026
+        assert df["properties"].iloc[0] == {"rating": 4.0}
+
     def test_filter_events(self):
         events = [ev("buy", "u1", t=0, target="i1"), ev("view", "u1", t=1, target="i1")]
         b = EventBatch.from_events(events).filter_events(["buy"])
