@@ -1,0 +1,65 @@
+"""Import sample rate/buy events through the REST event server.
+
+Parity: examples/scala-parallel-recommendation/*/data/import_eventserver.py
+(the reference ships an SDK import script per template).
+
+Usage:
+    python import_eventserver.py --access-key KEY [--url http://localhost:7070]
+"""
+
+import argparse
+import json
+import random
+import urllib.request
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--access-key", required=True)
+    p.add_argument("--url", default="http://localhost:7070")
+    p.add_argument("--users", type=int, default=50)
+    p.add_argument("--items", type=int, default=30)
+    p.add_argument("--events-per-user", type=int, default=10)
+    args = p.parse_args()
+
+    rng = random.Random(3)
+    events = []
+    for u in range(args.users):
+        for i in rng.sample(range(args.items), args.events_per_user):
+            if rng.random() < 0.8:
+                events.append(
+                    {
+                        "event": "rate",
+                        "entityType": "user",
+                        "entityId": f"u{u}",
+                        "targetEntityType": "item",
+                        "targetEntityId": f"i{i}",
+                        "properties": {"rating": float(rng.randint(1, 5))},
+                    }
+                )
+            else:
+                events.append(
+                    {
+                        "event": "buy",
+                        "entityType": "user",
+                        "entityId": f"u{u}",
+                        "targetEntityType": "item",
+                        "targetEntityId": f"i{i}",
+                    }
+                )
+
+    imported = 0
+    for start in range(0, len(events), 50):  # batch limit is 50
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            data=json.dumps(events[start : start + 50]).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            imported += sum(1 for x in json.loads(r.read()) if x["status"] == 201)
+    print(f"Imported {imported} events.")
+
+
+if __name__ == "__main__":
+    main()

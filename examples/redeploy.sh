@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Cron-able retrain + hot-redeploy loop.
+# Parity: examples/redeploy-script/redeploy.sh — the reference's
+# operational answer to model refresh. Here no restart is needed:
+# train writes a new COMPLETED engine instance and /reload hot-swaps
+# the serving models without dropping queries.
+set -euo pipefail
+
+ENGINE_DIR="${ENGINE_DIR:-$(dirname "$0")/recommendation}"
+QUERY_HOST="${QUERY_HOST:-127.0.0.1}"
+QUERY_PORT="${QUERY_PORT:-8000}"
+
+python -m predictionio_tpu.tools.cli train --engine-dir "$ENGINE_DIR"
+curl -fsS -X POST "http://${QUERY_HOST}:${QUERY_PORT}/reload"
+echo
+echo "redeployed $(date -u +%FT%TZ)"
