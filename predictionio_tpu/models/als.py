@@ -92,15 +92,19 @@ def _make_blocks(
     n_shards: int,
 ) -> _Blocks:
     per_shard = n_entity_pad // n_shards
-    shard = entity // per_shard
-    order = np.argsort(shard, kind="stable")
-    entity, other, rating, shard = (
-        entity[order],
-        other[order],
-        rating[order],
-        shard[order],
-    )
-    counts = np.bincount(shard, minlength=n_shards)
+    if n_shards == 1:
+        counts = np.array([len(entity)])
+        shard = None
+    else:
+        shard = entity // per_shard
+        order = np.argsort(shard, kind="stable")
+        entity, other, rating, shard = (
+            entity[order],
+            other[order],
+            rating[order],
+            shard[order],
+        )
+        counts = np.bincount(shard, minlength=n_shards)
     length = pad_to_multiple(int(counts.max()) if len(counts) else 1, 8)
     if length > _CHUNK:
         length = pad_to_multiple(length, _CHUNK)  # scan needs equal chunks

@@ -143,6 +143,25 @@ def test_failed_train_marks_instance_aborted(storage):
         store_mod.set_storage(None)
 
 
+def test_implicit_prefs_variant(app_with_events):
+    """train-with-view-event parity: implicitPrefs trains on the same engine."""
+    storage = app_with_events
+    engine = RecommendationEngine.apply()
+    import copy
+
+    variant = copy.deepcopy(VARIANT)
+    variant["algorithms"][0]["params"]["implicitPrefs"] = True
+    variant["algorithms"][0]["params"]["alpha"] = 10.0
+    ep = engine.params_from_variant(variant)
+    ctx = MeshContext.create()
+    models = engine.train(ctx, ep)
+    algo = engine.make_algorithms(ep)[0]
+    res = algo.predict(models[0], Query(user="u1", num=4))
+    assert len(res.itemScores) == 4
+    group0 = {f"i{i}" for i in range(8)}
+    assert sum(1 for s in res.itemScores if s.item in group0) >= 3
+
+
 def test_batch_predict_matches_per_query(app_with_events):
     storage = app_with_events
     engine = RecommendationEngine.apply()
