@@ -27,6 +27,30 @@ logger = logging.getLogger(__name__)
 UTC = _dt.timezone.utc
 
 
+class CleanupFunctions:
+    """End-of-workflow hooks (parity: workflow/CleanupFunctions.scala and
+    pypio's cleanup_functions): register callables to run when a train or
+    evaluation workflow finishes, success or failure."""
+
+    _fns: list = []
+
+    @classmethod
+    def add(cls, fn) -> None:
+        cls._fns.append(fn)
+
+    @classmethod
+    def run(cls) -> None:
+        for fn in cls._fns:
+            try:
+                fn()
+            except Exception:
+                logger.exception("cleanup function %r failed", fn)
+
+    @classmethod
+    def clear(cls) -> None:
+        cls._fns = []
+
+
 @dataclasses.dataclass
 class WorkflowParams:
     """Knobs of a workflow run (parity: workflow/WorkflowParams.scala)."""
@@ -123,6 +147,8 @@ def run_train(
         instance.end_time = _dt.datetime.now(tz=UTC)
         instances.update(instance)
         raise
+    finally:
+        CleanupFunctions.run()
 
     instance.status = instances.STATUS_COMPLETED
     instance.end_time = _dt.datetime.now(tz=UTC)

@@ -183,6 +183,39 @@ class EventBatch:
         )
 
 
+class EntityMap:
+    """Entity ids ↔ indices plus their property snapshots.
+
+    Parity: ``data/.../storage/EntityMap.scala`` (extractEntityMap) — the
+    view templates use to turn aggregated entity properties into an
+    index-aligned table.
+    """
+
+    def __init__(self, properties: dict):
+        from predictionio_tpu.data.bimap import BiMap as _BiMap
+
+        self._properties = dict(properties)
+        self.id_map = _BiMap.string_int(self._properties.keys())
+
+    def __len__(self) -> int:
+        return len(self._properties)
+
+    def __contains__(self, entity_id) -> bool:
+        return entity_id in self._properties
+
+    def properties(self, entity_id):
+        return self._properties[entity_id]
+
+    def index_of(self, entity_id) -> int:
+        return self.id_map[entity_id]
+
+    def entity_of(self, index: int):
+        return self.id_map.inverse[index]
+
+    def items(self):
+        return self._properties.items()
+
+
 @dataclass
 class Interactions:
     """Integer-indexed (user, item, rating, time) triples + their id tables."""

@@ -20,9 +20,24 @@ class Dashboard:
         self.service = HttpService("dashboard")
         self._register()
 
+    CORS_HEADERS = {  # parity: tools/dashboard/CorsSupport.scala
+        "Access-Control-Allow-Origin": "*",
+        "Access-Control-Allow-Methods": "GET, OPTIONS",
+        "Access-Control-Allow-Headers": "Content-Type",
+    }
+
     def _register(self):
         svc = self.service
         storage = self.storage
+
+        _orig_dispatch = svc.dispatch
+
+        def dispatch_with_cors(req):
+            resp = _orig_dispatch(req)
+            resp.headers.update(self.CORS_HEADERS)
+            return resp
+
+        svc.dispatch = dispatch_with_cors
 
         @svc.route("GET", r"/")
         def index(req):

@@ -82,6 +82,59 @@ class TestDistributed:
         assert distributed.is_multihost_env() is False
 
 
+class TestCleanupFunctions:
+    def test_runs_after_train_even_on_failure(self, storage):
+        from predictionio_tpu.core.workflow import CleanupFunctions, run_train
+        from predictionio_tpu.parallel.mesh import MeshContext
+        from sample_engine import AlgoParams, DSParams, PrepParams, make_engine
+        from predictionio_tpu.core.engine import EngineParams
+
+        calls = []
+        CleanupFunctions.clear()
+        CleanupFunctions.add(lambda: calls.append("ran"))
+        try:
+            engine = make_engine()
+            ep = EngineParams(
+                data_source_params=DSParams(id=1),
+                preparator_params=PrepParams(id=1),
+                algorithm_params_list=[("sample", AlgoParams(1))],
+            )
+            run_train(engine, ep, "f", storage=storage, ctx=MeshContext.create())
+            assert calls == ["ran"]
+            # failure path also runs cleanups
+            ep.data_source_params = DSParams(id=1, error=True)
+            with pytest.raises(ValueError):
+                run_train(engine, ep, "f", storage=storage, ctx=MeshContext.create())
+            assert calls == ["ran", "ran"]
+        finally:
+            CleanupFunctions.clear()
+
+
+class TestEntityMap:
+    def test_index_and_properties(self):
+        from predictionio_tpu.data.batch import EntityMap
+
+        em = EntityMap({"u1": {"a": 1}, "u2": {"a": 2}})
+        assert len(em) == 2 and "u1" in em
+        assert em.properties("u2") == {"a": 2}
+        assert em.entity_of(em.index_of("u1")) == "u1"
+
+
+class TestDashboardCors:
+    def test_cors_headers_present(self, storage):
+        import urllib.request
+
+        from predictionio_tpu.tools.dashboard import Dashboard
+
+        server = Dashboard(storage=storage)
+        port = server.start(port=0)
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as r:
+                assert r.headers["Access-Control-Allow-Origin"] == "*"
+        finally:
+            server.stop()
+
+
 class TestCliTemplateAndRun:
     def test_template_list_and_get(self, tmp_path, capsys):
         from predictionio_tpu.tools.cli import main
