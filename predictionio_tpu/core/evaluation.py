@@ -271,6 +271,10 @@ def run_evaluation(
         instance.end_time = _dt.datetime.now(tz=UTC)
         instances.update(instance)
         raise
+    finally:
+        from predictionio_tpu.core.workflow import CleanupFunctions
+
+        CleanupFunctions.run()
     result.instance_id = instance_id
 
     instance.status = instances.STATUS_COMPLETED

@@ -162,7 +162,12 @@ class SelfCleaningDataSource:
         if window is None:
             return None
         from predictionio_tpu.data.store import get_storage, resolve_app
+        from predictionio_tpu.parallel import distributed
 
+        if distributed.is_multihost_env() and not distributed.is_coordinator():
+            # destructive store rewrite must run exactly once: in SPMD every
+            # process executes read_training, so only the coordinator compacts
+            return None
         storage = storage or get_storage()
         app_id, channel_id = resolve_app(self.params.appName)
         return clean_persisted_events(storage, app_id, window, channel_id)

@@ -33,6 +33,7 @@ from predictionio_tpu.core import (
 )
 from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.core.evaluation import EngineParamsGenerator, Evaluation
+from predictionio_tpu.core.self_cleaning import SelfCleaningDataSource
 from predictionio_tpu.core.metrics import OptionAverageMetric
 from predictionio_tpu.data.batch import Interactions
 from predictionio_tpu.data.store import PEventStore
@@ -83,9 +84,12 @@ PreparedData = TrainingData
 class DataSourceParams(Params):
     appName: str = "default"
     evalParams: Optional[dict] = None  # {"kFold": 5, "queryNum": 10}
+    # SelfCleaningDataSource hook: {"duration": "30 days",
+    #   "removeDuplicates": true, "compressProperties": true}
+    eventWindow: Optional[dict] = None
 
 
-class RecommendationDataSource(DataSource):
+class RecommendationDataSource(SelfCleaningDataSource, DataSource):
     params_cls = DataSourceParams
 
     BUY_WEIGHT = 4.0  # parity: buy events count as rating 4.0
@@ -116,6 +120,7 @@ class RecommendationDataSource(DataSource):
         )
 
     def read_training(self, ctx) -> TrainingData:
+        self.clean_persisted_events()  # no-op without an eventWindow param
         return TrainingData(self._read_interactions())
 
     def read_eval(self, ctx):

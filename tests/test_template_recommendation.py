@@ -143,6 +143,38 @@ def test_failed_train_marks_instance_aborted(storage):
         store_mod.set_storage(None)
 
 
+def test_event_window_compaction_on_read(app_with_events):
+    """SelfCleaningDataSource hook: eventWindow compacts the store pre-read."""
+    storage = app_with_events
+    engine = RecommendationEngine.apply()
+    import copy
+
+    variant = copy.deepcopy(VARIANT)
+    variant["datasource"]["params"]["eventWindow"] = {
+        "duration": "365 days",
+        "removeDuplicates": True,
+    }
+    ep = engine.params_from_variant(variant)
+    ctx = MeshContext.create()
+    app_id = storage.get_meta_data_apps().get_by_name("testapp").id
+    before = len(list(storage.get_l_events().find(app_id)))
+    # duplicate one event so dedup has something to remove
+    evs = list(storage.get_l_events().find(app_id, limit=1))
+    storage.get_l_events().insert(
+        Event(
+            event=evs[0].event, entity_type=evs[0].entity_type,
+            entity_id=evs[0].entity_id,
+            target_entity_type=evs[0].target_entity_type,
+            target_entity_id=evs[0].target_entity_id,
+            properties=evs[0].properties, event_time=evs[0].event_time,
+        ),
+        app_id,
+    )
+    engine.train(ctx, ep)
+    after = len(list(storage.get_l_events().find(app_id)))
+    assert after == before  # the duplicate was compacted away
+
+
 def test_implicit_prefs_variant(app_with_events):
     """train-with-view-event parity: implicitPrefs trains on the same engine."""
     storage = app_with_events
