@@ -28,6 +28,7 @@ p=1; the global Gram matrix VᵀV is computed once per half-step (a k×k
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import partial
 from typing import Optional
 
@@ -42,6 +43,8 @@ from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.ops.segment import segment_sum
 from predictionio_tpu.parallel.mesh import DATA_AXIS, MeshContext, pad_to_multiple
 
+logger = logging.getLogger(__name__)
+
 
 @dataclasses.dataclass
 class ALSConfig:
@@ -51,6 +54,11 @@ class ALSConfig:
     implicit: bool = False
     alpha: float = 1.0  # implicit confidence scale
     seed: int = 3
+    # mid-training checkpoint/resume (orbax; SURVEY.md §5): factors + step
+    # saved every checkpoint_interval iterations under checkpoint_dir;
+    # training resumes from the latest step found there
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval: int = 5
 
 
 @dataclasses.dataclass
@@ -281,8 +289,68 @@ def train_als(
 
     u_blocks, i_blocks = put(ub), put(ib)
     step = _make_step(ctx.mesh, ub, ib, cfg)
-    for _ in range(cfg.iterations):
+
+    start_iter = 0
+    manager = None
+    if cfg.checkpoint_dir:
+        if cfg.checkpoint_interval < 1:
+            raise ValueError(
+                f"checkpoint_interval must be >= 1, got {cfg.checkpoint_interval}"
+            )
+        from predictionio_tpu.core.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(cfg.checkpoint_dir)
+        # fingerprint ties checkpoints to THIS config + dataset: a stale or
+        # foreign checkpoint is ignored (fresh start), never silently loaded
+        fingerprint = np.array(
+            [
+                n_users_pad,
+                n_items_pad,
+                len(rating),
+                cfg.rank,
+                int(cfg.implicit),
+                cfg.seed,
+                float(np.sum(rating, dtype=np.float64)),
+                float(np.sum(user, dtype=np.float64)),
+                float(np.sum(item, dtype=np.float64)),
+                float(cfg.reg),
+                float(cfg.alpha),
+            ],
+            dtype=np.float64,
+        )
+        latest = manager.latest_step()
+        if latest is not None and latest <= cfg.iterations:
+            state = manager.restore(
+                latest,
+                ctx=ctx,
+                shardings={"U": sharding, "V": sharding, "fingerprint": None},
+            )
+            saved_fp = np.asarray(jax.device_get(state.get("fingerprint")))
+            if saved_fp.shape == fingerprint.shape and np.allclose(
+                saved_fp, fingerprint
+            ):
+                U, V = state["U"], state["V"]
+                start_iter = latest
+            else:
+                logger.warning(
+                    "checkpoint at %s does not match this config/dataset; "
+                    "starting fresh", cfg.checkpoint_dir,
+                )
+        elif latest is not None:
+            logger.warning(
+                "checkpoint step %d exceeds iterations=%d; starting fresh",
+                latest,
+                cfg.iterations,
+            )
+
+    for it in range(start_iter, cfg.iterations):
         U, V = step(U, V, u_blocks, i_blocks)
+        if manager is not None and (
+            (it + 1) % cfg.checkpoint_interval == 0 or it + 1 == cfg.iterations
+        ):
+            manager.save(
+                it + 1, {"U": U, "V": V, "fingerprint": fingerprint}
+            )
     U_host = np.asarray(jax.device_get(U))[:n_users]
     V_host = np.asarray(jax.device_get(V))[:n_items]
     return ALSModel(

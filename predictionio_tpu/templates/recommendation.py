@@ -179,6 +179,11 @@ class ALSAlgorithmParams(Params):
     implicitPrefs: bool = False
     alpha: float = 1.0
     seed: Optional[int] = None
+    # mid-training checkpoint/resume (reference knob: ALS
+    # setCheckpointInterval, ALSAlgorithm.scala:85 — here it persists
+    # progress via orbax instead of truncating RDD lineage)
+    checkpointDir: Optional[str] = None
+    checkpointInterval: int = 5
 
     json_aliases = {"lambda": "reg"}
 
@@ -201,6 +206,8 @@ class ALSAlgorithm(Algorithm):
             implicit=p.implicitPrefs,
             alpha=p.alpha,
             seed=3 if p.seed is None else p.seed,
+            checkpoint_dir=p.checkpointDir,
+            checkpoint_interval=p.checkpointInterval,
         )
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
