@@ -196,6 +196,38 @@ class TestStats:
         assert counts[("", 400)] >= 1
 
 
+class TestTLS:
+    def test_https_event_server(self, storage, tmp_path):
+        import ssl
+        import subprocess
+
+        r = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+             "-keyout", str(tmp_path / "key.pem"),
+             "-out", str(tmp_path / "cert.pem"),
+             "-days", "1", "-nodes", "-subj", "/CN=localhost"],
+            capture_output=True,
+        )
+        if r.returncode != 0:
+            pytest.skip("openssl unavailable")
+        es = EventServer(storage=storage)
+        port = es.start(
+            host="127.0.0.1", port=0,
+            cert_path=str(tmp_path / "cert.pem"),
+            key_path=str(tmp_path / "key.pem"),
+        )
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{port}/", context=ctx, timeout=5
+            ) as resp:
+                assert json.loads(resp.read())["status"] == "alive"
+        finally:
+            es.stop()
+
+
 class TestWebhooks:
     def test_segmentio_track(self, server):
         url = server["base"] + f"/webhooks/segmentio.json?accessKey={server['key']}"
