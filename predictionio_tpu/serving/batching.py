@@ -1,0 +1,103 @@
+"""Request micro-batching: coalesce concurrent queries into one device pass.
+
+TPU serving throughput comes from batching: a single (B, rank)×(rank, items)
+scoring pass costs barely more than B=1, and on remote-tunnel backends each
+device round trip has a fixed latency floor.  The reference has no analogue
+(its predict path is per-request JVM work, ``CreateServer.scala:508``).
+
+:class:`MicroBatcher` sits between HTTP handler threads and the engine:
+handlers enqueue (query, event) pairs and block; a worker drains the queue,
+waits up to ``window_ms`` to let a batch form (bounded by ``max_batch``),
+routes the whole batch through ``Algorithm.batch_predict`` (which engines
+like ALS vectorize on device), and wakes each handler with its result.
+Errors are delivered per-request.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Pending:
+    query: Any
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Any = None
+    error: Optional[BaseException] = None
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        run_batch: Callable[[list], list],
+        max_batch: int = 64,
+        window_ms: float = 2.0,
+    ):
+        self._run_batch = run_batch
+        self.max_batch = max_batch
+        self.window_s = window_ms / 1e3
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(
+            target=self._loop, name="query-microbatcher", daemon=True
+        )
+        self._worker.start()
+
+    def submit(self, query: Any, timeout: float = 30.0) -> Any:
+        p = _Pending(query)
+        self._queue.put(p)
+        if not p.event.wait(timeout):
+            raise TimeoutError("batched query timed out")
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._worker.join(timeout=5)
+        # wake anything still queued so handlers fail fast, not on timeout
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            p.error = RuntimeError("server shutting down")
+            p.event.set()
+
+    # -- worker -------------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            # brief accumulation window lets concurrent requests coalesce;
+            # skipped when a full batch is already waiting
+            if self._queue.qsize() < self.max_batch - 1:
+                self._stop.wait(self.window_s)
+            while len(batch) < self.max_batch:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            try:
+                results = self._run_batch([p.query for p in batch])
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"batch_predict returned {len(results)} results for "
+                        f"{len(batch)} queries"
+                    )
+                for p, r in zip(batch, results):
+                    p.result = r
+            except BaseException as e:  # propagate to EVERY waiter
+                for p in batch:
+                    p.error = e
+            for p in batch:
+                p.event.set()

@@ -96,6 +96,9 @@ class QueryServer:
         event_server_url: Optional[str] = None,
         access_key: Optional[str] = None,
         plugins: Optional[list[EngineServerPlugin]] = None,
+        batching: bool = False,
+        max_batch: int = 64,
+        batch_window_ms: float = 2.0,
     ):
         self.engine = engine
         self.storage = storage or Storage.instance()
@@ -118,6 +121,14 @@ class QueryServer:
         self.service = HttpService("queryserver")
         self._register_routes()
         self.reload()
+        self._batcher = None
+        if batching:
+            from predictionio_tpu.serving.batching import MicroBatcher
+
+            self._batcher = MicroBatcher(
+                self._run_query_batch, max_batch=max_batch,
+                window_ms=batch_window_ms,
+            )
 
     # -- model lifecycle -----------------------------------------------------
     def reload(self) -> str:
@@ -140,18 +151,40 @@ class QueryServer:
         logger.info("deployed engine instance %s", instance.id)
         return instance.id
 
+    # -- batched path: one Algorithm.batch_predict pass for N queries --------
+    def _run_query_batch(self, queries: list) -> list:
+        with self._lock:
+            deployed = self._deployed
+        supplemented = [
+            (i, deployed.serving.supplement(q)) for i, q in enumerate(queries)
+        ]
+        per_algo = [
+            dict(algo.batch_predict(model, supplemented))
+            for algo, model in zip(deployed.algorithms, deployed.models)
+        ]
+        out = []
+        for i, (_, sq) in enumerate(supplemented):
+            preds = [d[i] for d in per_algo if i in d]
+            out.append(deployed.serving.serve(sq, preds))
+        return out
+
     # -- query hot loop (parity: CreateServer.scala:484-634) -----------------
     def handle_query(self, data: dict) -> dict:
         t0 = time.perf_counter()
         with self._lock:
             deployed = self._deployed
         query = bind_query(self.engine.query_cls, data)
-        supplemented = deployed.serving.supplement(query)
-        predictions = [
-            algo.predict(model, supplemented)
-            for algo, model in zip(deployed.algorithms, deployed.models)
-        ]
-        prediction = deployed.serving.serve(supplemented, predictions)
+        if self._batcher is not None:
+            prediction = self._batcher.submit(query)
+            # supplement ran inside the batch; plugins see the bound query
+            supplemented = query
+        else:
+            supplemented = deployed.serving.supplement(query)
+            predictions = [
+                algo.predict(model, supplemented)
+                for algo, model in zip(deployed.algorithms, deployed.models)
+            ]
+            prediction = deployed.serving.serve(supplemented, predictions)
         # plugins see JSON values, as in the reference (JValue-based process)
         result = _to_jsonable(prediction)
         for p in self.plugins:
@@ -280,4 +313,6 @@ class QueryServer:
         return actual
 
     def stop(self) -> None:
+        if self._batcher is not None:
+            self._batcher.stop()
         self.service.stop()

@@ -309,6 +309,7 @@ def cmd_deploy(args) -> int:
         ),
         access_key=args.accesskey,
         plugins=load_plugins(args.plugin),
+        batching=args.batching,
     )
     port = qs.start(args.ip, args.port, cert_path=args.cert_path,
                     key_path=args.key_path)
@@ -547,6 +548,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--plugin", action="append", default=[])
     sp.add_argument("--cert-path", default=None)
     sp.add_argument("--key-path", default=None)
+    sp.add_argument("--batching", action="store_true",
+                    help="micro-batch concurrent queries into one device pass")
     sp.set_defaults(func=cmd_deploy)
 
     sp = sub.add_parser("undeploy")
