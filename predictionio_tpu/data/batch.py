@@ -144,7 +144,10 @@ class EventBatch:
         return BiMap.string_int(self.entity_id)
 
     def target_bimap(self) -> BiMap[str, int]:
-        return BiMap.string_int(t for t in self.target_entity_id if t is not None)
+        import pandas as pd
+
+        mask = pd.notna(self.target_entity_id)
+        return BiMap.string_int(self.target_entity_id[mask])
 
     def property_column(self, key: str, default: float = np.nan) -> np.ndarray:
         """Extract one numeric property across all rows as float64."""
