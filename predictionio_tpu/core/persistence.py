@@ -20,7 +20,7 @@ from __future__ import annotations
 import abc
 import importlib
 import pickle
-from typing import Any, Optional
+from typing import Any
 
 
 class _RetrainSentinel:

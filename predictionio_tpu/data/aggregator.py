@@ -18,7 +18,7 @@ import datetime as _dt
 from dataclasses import dataclass
 from typing import Iterable, Optional
 
-from predictionio_tpu.data.event import DataMap, Event, EventValidation, PropertyMap
+from predictionio_tpu.data.event import Event, EventValidation, PropertyMap
 
 
 @dataclass

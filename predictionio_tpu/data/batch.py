@@ -17,7 +17,7 @@ from typing import Callable, Iterable, Iterator, Optional, Sequence
 import numpy as np
 
 from predictionio_tpu.data.bimap import BiMap
-from predictionio_tpu.data.event import Event, utcnow
+from predictionio_tpu.data.event import Event
 
 
 @dataclass

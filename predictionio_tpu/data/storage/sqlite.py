@@ -16,7 +16,7 @@ import json
 import os
 import sqlite3
 import threading
-from typing import Iterable, Optional, Sequence
+from typing import Iterable, Optional
 
 from predictionio_tpu.data.batch import EventBatch
 from predictionio_tpu.data.event import DataMap, Event, new_event_id

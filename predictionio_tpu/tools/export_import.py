@@ -7,7 +7,6 @@ one Event JSON per line, the reference's interchange format.
 
 from __future__ import annotations
 
-import json
 from typing import Optional
 
 from predictionio_tpu.data.event import Event
