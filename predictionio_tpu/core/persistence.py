@@ -99,7 +99,12 @@ def deserialize_models(
             models.append(algo.load_serializable_model(ctx, payload))
         elif kind == "manifest":
             cls = resolve_class(payload)
-            models.append(cls.load(instance_id, params, ctx))
+            # manifest loaders return HOST-form models; route through the
+            # algorithm's load hook so deploy-side state (device placement,
+            # scorers) binds to THIS ctx, same as the pickle path
+            models.append(
+                algo.load_serializable_model(ctx, cls.load(instance_id, params, ctx))
+            )
         elif kind == "retrain":
             models.append(None)
             retrain_idx.append(i)

@@ -18,9 +18,9 @@ from predictionio_tpu.data.storage import base
 class LocalFSModels(base.Models):
     def __init__(self, source_name: str = "default", path: Optional[str] = None, **_):
         if path is None:
-            base_dir = os.environ.get(
-                "PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store")
-            )
+            from predictionio_tpu.utils.fs import pio_base_dir
+
+            base_dir = pio_base_dir()
             path = os.path.join(base_dir, "models", source_name)
         self._dir = path
         os.makedirs(self._dir, exist_ok=True)

@@ -61,7 +61,9 @@ def _lock_for(path: str) -> threading.RLock:
 
 
 def _default_path(source_name: str) -> str:
-    base_dir = os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+    from predictionio_tpu.utils.fs import pio_base_dir
+
+    base_dir = pio_base_dir()
     return os.path.join(base_dir, "parquet", source_name.lower())
 
 

@@ -362,6 +362,72 @@ def train_als(
     )
 
 
+class CheckpointedALSModel(ALSModel):
+    """ALSModel persisted through the PersistentModel protocol via orbax.
+
+    Parity: the reference's mode-2 persistence (``PersistentModel.save`` +
+    manifest, ``controller/PersistentModel.scala``) — only a manifest naming
+    this class goes into MODELDATA; the factor matrices live as an orbax
+    checkpoint (sharded-array friendly), id maps beside it.  Deploy calls
+    :meth:`load` to rebuild.
+    """
+
+    @staticmethod
+    def _dir(instance_id: str) -> str:
+        import os
+
+        from predictionio_tpu.utils.fs import pio_base_dir
+
+        base = pio_base_dir()
+        return os.path.join(base, "persistent_models", instance_id)
+
+    def save(self, instance_id: str, params) -> bool:
+        import os
+        import pickle
+
+        from predictionio_tpu.core.checkpoint import save_pytree
+
+        d = self._dir(instance_id)
+        os.makedirs(d, exist_ok=True)
+        save_pytree(
+            os.path.join(d, "factors"),
+            {"user_factors": self.user_factors, "item_factors": self.item_factors},
+        )
+        with open(os.path.join(d, "maps.pkl"), "wb") as f:
+            pickle.dump(
+                {"user_map": self.user_map, "item_map": self.item_map,
+                 "config": self.config},
+                f,
+            )
+        return True  # manifest mode: MODELDATA stores only the class path
+
+    @classmethod
+    def load(cls, instance_id: str, params, ctx) -> "CheckpointedALSModel":
+        import os
+        import pickle
+
+        from predictionio_tpu.core.checkpoint import restore_pytree
+
+        d = cls._dir(instance_id)
+        factors = restore_pytree(os.path.join(d, "factors"))
+        with open(os.path.join(d, "maps.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        return cls(
+            user_factors=np.asarray(factors["user_factors"]),
+            item_factors=np.asarray(factors["item_factors"]),
+            user_map=meta["user_map"],
+            item_map=meta["item_map"],
+            config=meta["config"],
+        )
+
+
+# PersistentModel registration: dataclass inheritance keeps ALSModel's fields;
+# isinstance checks in core/persistence.py look for the protocol
+from predictionio_tpu.core.persistence import PersistentModel  # noqa: E402
+
+PersistentModel.register(CheckpointedALSModel)
+
+
 class ALSScorer:
     """Serving-side top-N ranking with factors resident on device.
 

@@ -184,6 +184,11 @@ class ALSAlgorithmParams(Params):
     # progress via orbax instead of truncating RDD lineage)
     checkpointDir: Optional[str] = None
     checkpointInterval: int = 5
+    # deploy-time persistence mode (the reference's three modes):
+    #   auto       — pickled blob in MODELDATA (default)
+    #   checkpoint — PersistentModel manifest + orbax factors
+    #   retrain    — retrain on deploy (Unit-model mode)
+    persistMode: str = "auto"
 
     json_aliases = {"lambda": "reg"}
 
@@ -197,8 +202,14 @@ class ALSAlgorithm(Algorithm):
         super().__init__(params)
         self._scorers: dict[int, ALSScorer] = {}
 
+    VALID_PERSIST_MODES = ("auto", "checkpoint", "retrain")
+
     def _config(self) -> ALSConfig:
         p = self.params
+        if p.persistMode not in self.VALID_PERSIST_MODES:
+            raise ValueError(
+                f"persistMode {p.persistMode!r} not in {self.VALID_PERSIST_MODES}"
+            )
         return ALSConfig(
             rank=p.rank,
             iterations=p.numIterations,
@@ -219,8 +230,22 @@ class ALSAlgorithm(Algorithm):
                     p.numIterations,
                 )
         model = train_als(ctx, pd.interactions, self._config())
+        if self.params.persistMode == "checkpoint":
+            from predictionio_tpu.models.als import CheckpointedALSModel
+
+            model = CheckpointedALSModel(
+                model.user_factors, model.item_factors,
+                model.user_map, model.item_map, model.config,
+            )
         self._scorers[id(model)] = ALSScorer(ctx, model)
         return model
+
+    def make_serializable_model(self, model):
+        if self.params.persistMode == "retrain":
+            from predictionio_tpu.core.persistence import RETRAIN
+
+            return RETRAIN
+        return super().make_serializable_model(model)
 
     def load_serializable_model(self, ctx, blob) -> ALSModel:
         """Bind the deploy mesh to the scorer (called by prepare_deploy)."""

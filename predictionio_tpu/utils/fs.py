@@ -1,0 +1,10 @@
+"""Filesystem roots shared across storage drivers and model persistence."""
+
+from __future__ import annotations
+
+import os
+
+
+def pio_base_dir() -> str:
+    """The framework's on-disk root (PIO_FS_BASEDIR, parity: conf/pio-env)."""
+    return os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))

@@ -143,6 +143,35 @@ def test_failed_train_marks_instance_aborted(storage):
         store_mod.set_storage(None)
 
 
+@pytest.mark.parametrize("mode", ["checkpoint", "retrain"])
+def test_persist_modes_deploy(app_with_events, tmp_path, monkeypatch, mode):
+    """All three deploy-time persistence modes serve identical queries."""
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    storage = app_with_events
+    engine = RecommendationEngine.apply()
+    import copy
+
+    variant = copy.deepcopy(VARIANT)
+    variant["algorithms"][0]["params"]["persistMode"] = mode
+    ep = engine.params_from_variant(variant)
+    ctx = MeshContext.create()
+    iid = run_train(engine, ep, VARIANT["engineFactory"], storage=storage, ctx=ctx)
+    inst = storage.get_meta_data_engine_instances().get(iid)
+    if mode == "checkpoint":
+        # MODELDATA holds only a manifest; factors live in the orbax dir
+        import pickle
+
+        slots = pickle.loads(storage.get_model_data_models().get(iid).models)
+        assert slots[0][0] == "manifest"
+        assert (tmp_path / "persistent_models" / iid / "maps.pkl").exists()
+    _, algorithms, serving, models = prepare_deploy(
+        engine, inst, storage=storage, ctx=ctx
+    )
+    q = serving.supplement(Query(user="u1", num=3))
+    res = serving.serve(q, [algorithms[0].predict(models[0], q)])
+    assert len(res.itemScores) == 3
+
+
 def test_event_window_compaction_on_read(app_with_events):
     """SelfCleaningDataSource hook: eventWindow compacts the store pre-read."""
     storage = app_with_events
