@@ -183,6 +183,40 @@ class SimilarALSAlgorithm(Algorithm):
             als=als, norm_factors=norm_factors, item_categories=pd.item_categories
         )
 
+    def batch_predict(self, model: SimilarALSModel, queries):
+        """One matmul for a whole evaluation batch of filter-free queries."""
+        simple, fallback = [], []
+        for i, q in queries:
+            idxs = [
+                model.als.item_map[it] for it in q.items if it in model.als.item_map
+            ]
+            if idxs and not (q.blackList or q.whiteList or q.categories):
+                simple.append((i, idxs, q))
+            else:
+                fallback.append((i, q))
+        by_index = dict(super().batch_predict(model, fallback)) if fallback else {}
+        if simple:
+            n_items = model.norm_factors.shape[0]
+            Q = np.stack(
+                [model.norm_factors[idxs].mean(axis=0) for _, idxs, _ in simple]
+            )
+            sims = Q @ model.norm_factors.T  # (B, n_items)
+            for row, (i, idxs, q) in enumerate(simple):
+                s = sims[row].copy()
+                s[np.asarray(idxs)] = -np.inf
+                k = min(q.num, n_items)
+                top = np.argpartition(-s, k - 1)[:k]
+                top = top[np.argsort(-s[top])]
+                inv = model.als.item_map.inverse
+                by_index[i] = PredictedResult(
+                    itemScores=[
+                        ItemScore(inv[int(j)], float(s[j]))
+                        for j in top
+                        if np.isfinite(s[j])
+                    ]
+                )
+        return list(by_index.items())
+
     def predict(self, model: SimilarALSModel, query: Query) -> PredictedResult:
         item_map = model.als.item_map
         idxs = [item_map[it] for it in query.items if it in item_map]

@@ -163,6 +163,37 @@ class TestSimilarProductTemplate:
         # unknown item → empty
         assert query(Query(items=["zzz"], num=3)).itemScores == []
 
+    def test_als_batch_predict_matches_single(self, app, ctx):
+        from predictionio_tpu.templates.similarproduct import (
+            Query,
+            SimilarProductEngine,
+        )
+
+        self.seed_views(app["le"], app["app_id"])
+        engine = SimilarProductEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {"name": "als", "params": {"rank": 6, "numIterations": 4}}
+                ],
+            }
+        )
+        algo = engine.make_algorithms(ep)[0]
+        model = engine.train(ctx, ep, algorithms=[algo])[0]
+        queries = [
+            (0, Query(items=["i0"], num=3)),
+            (1, Query(items=["i5", "i6"], num=2)),
+            (2, Query(items=["zzz"], num=2)),  # unknown → fallback
+            (3, Query(items=["i0"], num=3, categories=["even"])),  # fallback
+        ]
+        batch = dict(algo.batch_predict(model, queries))
+        for i, q in queries:
+            single = algo.predict(model, q)
+            assert [s.item for s in batch[i].itemScores] == [
+                s.item for s in single.itemScores
+            ], i
+
     def test_llr_mode(self, app, ctx):
         from predictionio_tpu.templates.similarproduct import (
             Query,
