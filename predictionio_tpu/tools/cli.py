@@ -40,13 +40,17 @@ def _die(msg: str, code: int = 1) -> int:
 
 
 def load_variant(args) -> dict:
-    path = getattr(args, "variant", None) or os.path.join(
-        getattr(args, "engine_dir", None) or os.getcwd(), "engine.json"
-    )
+    engine_dir = getattr(args, "engine_dir", None) or os.getcwd()
+    path = getattr(args, "variant", None) or os.path.join(engine_dir, "engine.json")
     if not os.path.exists(path):
         raise FileNotFoundError(
             f"{path} not found. Run from an engine directory or pass --variant."
         )
+    # user engine code lives beside engine.json (parity: `pio build` compiles
+    # the engine directory) — make it importable for engineFactory resolution
+    for p in (engine_dir, os.path.dirname(os.path.abspath(path))):
+        if p and p not in sys.path:
+            sys.path.insert(0, p)
     with open(path) as f:
         variant = json.load(f)
     if "engineFactory" not in variant:

@@ -125,6 +125,41 @@ class TestCliTrainDeployFlow:
         pred = json.loads(ofile.read_text().splitlines()[0])
         assert len(pred["prediction"]["itemScores"]) == 3
 
+    def test_user_engine_in_engine_dir(self, cli_env, tmp_path, capsys):
+        """engineFactory defined in a module BESIDE engine.json imports
+        (parity: pio build compiles the engine directory)."""
+        (tmp_path / "myengine.py").write_text(
+            "import dataclasses\n"
+            "import numpy as np\n"
+            "from predictionio_tpu.core import (Algorithm, DataSource, Engine,\n"
+            "    EngineFactory, FirstServing, IdentityPreparator)\n"
+            "class DS(DataSource):\n"
+            "    def read_training(self, ctx):\n"
+            "        return np.arange(4.0)\n"
+            "class Mean(Algorithm):\n"
+            "    def train(self, ctx, pd):\n"
+            "        return float(pd.mean())\n"
+            "    def predict(self, model, q):\n"
+            "        return {'mean': model}\n"
+            "class MyEngine(EngineFactory):\n"
+            "    @classmethod\n"
+            "    def apply(cls):\n"
+            "        return Engine(DS, IdentityPreparator, {'mean': Mean},\n"
+            "                      FirstServing)\n"
+        )
+        (tmp_path / "engine.json").write_text(
+            json.dumps(
+                {
+                    "id": "default",
+                    "engineFactory": "myengine.MyEngine",
+                    "algorithms": [{"name": "mean"}],
+                }
+            )
+        )
+        assert run_cli("build", "--engine-dir", str(tmp_path)) == 0
+        assert "ready for training" in capsys.readouterr().out
+        assert run_cli("train", "--engine-dir", str(tmp_path)) == 0
+
     def test_train_missing_variant_fails_cleanly(self, cli_env, tmp_path, capsys):
         assert run_cli("train", "--variant", str(tmp_path / "nope.json")) == 1
         assert "not found" in capsys.readouterr().err
