@@ -20,6 +20,40 @@ from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.data.event import Event
 
 
+class LazyJsonProperties(Sequence):
+    """Row-aligned property dicts decoded from JSON strings on access.
+
+    Bulk storage keeps properties as JSON; decoding 25M rows eagerly costs
+    minutes, and most pipelines touch only a numeric key or two (via
+    promoted columns) or a small row subset. Decoded rows are cached.
+    """
+
+    __slots__ = ("_raw", "_cache")
+
+    def __init__(self, raw: np.ndarray):
+        self._raw = raw  # object array of JSON strings
+        self._cache: dict[int, dict] = {}
+
+    def __len__(self) -> int:
+        return len(self._raw)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        i = int(i)
+        got = self._cache.get(i)
+        if got is None:
+            import json
+
+            raw = self._raw[i]
+            got = json.loads(raw) if raw else {}
+            self._cache[i] = got
+        return got
+
+    def subset(self, idx: np.ndarray) -> "LazyJsonProperties":
+        return LazyJsonProperties(self._raw[idx])
+
+
 @dataclass
 class EventBatch:
     """A set of events in structure-of-arrays form."""
@@ -30,11 +64,14 @@ class EventBatch:
     target_entity_type: np.ndarray  # object (str | None)
     target_entity_id: np.ndarray  # object (str | None)
     event_time: np.ndarray  # float64 epoch seconds
-    properties: list[dict]  # row-aligned property dicts
+    properties: Sequence  # row-aligned property dicts (list or LazyJson)
     event_id: np.ndarray = None  # object (str | None)
     tags: list[tuple] = None  # row-aligned tag tuples
     pr_id: np.ndarray = None  # object (str | None)
     creation_time: np.ndarray = None  # float64 epoch seconds
+    # storage-promoted numeric property columns (e.g. parquet parts):
+    # property_column() serves from here without touching JSON
+    numeric_properties: Optional[dict] = None
 
     def __post_init__(self):
         n = len(self.event)
@@ -97,6 +134,11 @@ class EventBatch:
 
     def select(self, mask: np.ndarray) -> "EventBatch":
         idx = np.nonzero(mask)[0]
+        props = (
+            self.properties.subset(idx)
+            if isinstance(self.properties, LazyJsonProperties)
+            else [self.properties[i] for i in idx]
+        )
         return EventBatch(
             event=self.event[idx],
             entity_type=self.entity_type[idx],
@@ -104,11 +146,16 @@ class EventBatch:
             target_entity_type=self.target_entity_type[idx],
             target_entity_id=self.target_entity_id[idx],
             event_time=self.event_time[idx],
-            properties=[self.properties[i] for i in idx],
+            properties=props,
             event_id=self.event_id[idx],
             tags=[self.tags[i] for i in idx],
             pr_id=self.pr_id[idx],
             creation_time=self.creation_time[idx],
+            numeric_properties=(
+                {k: v[idx] for k, v in self.numeric_properties.items()}
+                if self.numeric_properties
+                else None
+            ),
         )
 
     def filter_events(self, names: Sequence[str]) -> "EventBatch":
@@ -150,7 +197,13 @@ class EventBatch:
         return BiMap.string_int(self.target_entity_id[mask])
 
     def property_column(self, key: str, default: float = np.nan) -> np.ndarray:
-        """Extract one numeric property across all rows as float64."""
+        """Extract one numeric property across all rows as float64.
+
+        Served from storage-promoted columns when available (no JSON touch).
+        """
+        if self.numeric_properties is not None and key in self.numeric_properties:
+            col = self.numeric_properties[key].astype(np.float64)
+            return np.where(np.isnan(col), default, col)
         return np.array(
             [float(p.get(key, default)) for p in self.properties], dtype=np.float64
         )

@@ -26,7 +26,7 @@ from typing import Iterable, Optional, Sequence
 
 import numpy as np
 
-from predictionio_tpu.data.batch import EventBatch
+from predictionio_tpu.data.batch import EventBatch, LazyJsonProperties
 from predictionio_tpu.data.event import DataMap, Event, new_event_id
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.memory import match_event
@@ -65,6 +65,24 @@ def _default_path(source_name: str) -> str:
 
     base_dir = pio_base_dir()
     return os.path.join(base_dir, "parquet", source_name.lower())
+
+
+def _coerce_numeric(v) -> float:
+    """The ONE numeric coercion rule shared by WAL fill and part promotion —
+    mirrors the JSON fallback ``float(p[key])`` (strings coerce, bools → 1.0);
+    uncoercible values yield NaN."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return float("nan")
+
+
+def _value_coercible(v) -> bool:
+    try:
+        float(v)
+        return True
+    except (TypeError, ValueError):
+        return False
 
 
 def _event_to_row(event: Event, eid: str) -> dict:
@@ -138,37 +156,86 @@ class _Namespace:
         )
 
     def read_columns(self) -> dict[str, np.ndarray]:
-        """All rows (parts + WAL inserts − deletes) as column arrays."""
+        """All rows (parts + WAL inserts − deletes) as column arrays.
+
+        Arrow columns convert straight to numpy (no Python row lists);
+        promoted numeric property columns (``pnum_<key>``) ride along under
+        the ``numeric:<key>`` keys with WAL rows filled from their JSON.
+        """
+        import pyarrow as pa
         import pyarrow.parquet as pq
 
         with self.lock:
             tables = [pq.read_table(p) for p in self.part_paths()]
             wal = self.read_wal()
-        cols: dict[str, list] = {c: [] for c in _SCHEMA_COLS}
-        for t in tables:
-            d = t.to_pydict()
+        if tables:
+            merged = pa.concat_tables(tables, promote_options="default")
+            cols: dict[str, np.ndarray] = {}
             for c in _SCHEMA_COLS:
-                cols[c].extend(d[c])
+                np_col = merged.column(c).to_numpy(zero_copy_only=False)
+                if c in ("event_time", "creation_time"):
+                    cols[c] = np_col.astype(np.float64)
+                else:
+                    cols[c] = np_col.astype(object)
+            # a promoted key is trustworthy only if EVERY part carries it —
+            # concat null-fills missing columns, which would silently shadow
+            # real JSON values in parts written without promotion
+            per_part_keys = [
+                {n[5:] for n in t.schema.names if n.startswith("pnum_")}
+                for t in tables
+            ]
+            numeric_keys = set.intersection(*per_part_keys) if per_part_keys else set()
+            numeric = {
+                k: merged.column(f"pnum_{k}")
+                .to_numpy(zero_copy_only=False)
+                .astype(np.float64)
+                for k in sorted(numeric_keys)
+            }
+        else:
+            cols = {
+                c: (
+                    np.empty(0, np.float64)
+                    if c in ("event_time", "creation_time")
+                    else np.empty(0, object)
+                )
+                for c in _SCHEMA_COLS
+            }
+            numeric = {}
+
         deletes = set()
+        wal_rows = []
         for op in wal:
             if op.get("op") == "delete":
                 deletes.add(op["id"])
             else:
-                for c in _SCHEMA_COLS:
-                    cols[c].append(op["row"][c])
-        out: dict[str, np.ndarray] = {}
-        ids = cols["id"]
-        keep = [i for i, eid in enumerate(ids) if eid not in deletes]
-        for c in _SCHEMA_COLS:
-            vals = cols[c]
-            if c in ("event_time", "creation_time"):
-                out[c] = np.array([vals[i] for i in keep], dtype=np.float64)
-            else:
-                arr = np.empty(len(keep), dtype=object)
-                for j, i in enumerate(keep):
-                    arr[j] = vals[i]
-                out[c] = arr
-        return out
+                wal_rows.append(op["row"])
+        if wal_rows:
+            for c in _SCHEMA_COLS:
+                extra = np.empty(len(wal_rows), dtype=object)
+                for j, r in enumerate(wal_rows):
+                    extra[j] = r[c]
+                if c in ("event_time", "creation_time"):
+                    extra = extra.astype(np.float64)
+                cols[c] = np.concatenate([cols[c], extra])
+            if numeric:
+                parsed = [json.loads(r["properties"]) for r in wal_rows]
+                for k in numeric:
+                    extra = np.array(
+                        [
+                            _coerce_numeric(p[k]) if k in p else np.nan
+                            for p in parsed
+                        ],
+                        dtype=np.float64,
+                    )
+                    numeric[k] = np.concatenate([numeric[k], extra])
+        if deletes:
+            keep = ~np.isin(cols["id"], np.array(list(deletes), dtype=object))
+            for c in _SCHEMA_COLS:
+                cols[c] = cols[c][keep]
+            numeric = {k: v[keep] for k, v in numeric.items()}
+        for k, v in numeric.items():
+            cols[f"numeric:{k}"] = v
+        return cols
 
     def wal_bytes(self) -> int:
         try:
@@ -176,38 +243,83 @@ class _Namespace:
         except OSError:
             return 0
 
+    def _next_seq(self) -> int:
+        parts = self.part_paths()
+        if not parts:
+            return 0
+        last = os.path.basename(parts[-1])
+        return int(last[len("events-") : -len(".parquet")]) + 1
+
+    def write_part(self, cols: dict[str, np.ndarray], replace_all: bool = False):
+        """Write an immutable sorted part from column arrays.
+
+        ``cols`` holds the schema columns plus optional ``numeric:<key>``
+        promoted columns; rows are sorted by event_time. With
+        ``replace_all`` the new part supersedes every existing part + WAL
+        (compaction); otherwise it is appended as a fresh part (bulk write).
+        """
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        self.ensure()
+        with self.lock:
+            order = np.argsort(cols["event_time"], kind="stable")
+            data = {c: cols[c][order] for c in _SCHEMA_COLS}
+            for k in cols:
+                if k.startswith("numeric:"):
+                    data[f"pnum_{k[8:]}"] = cols[k][order]
+            table = pa.table(data)
+            seq = self._next_seq()
+            tmp = os.path.join(self.dir, f".tmp-events-{seq:06d}.parquet")
+            pq.write_table(table, tmp)
+            if replace_all:
+                for p in self.part_paths():
+                    os.remove(p)
+            os.replace(tmp, os.path.join(self.dir, f"events-{seq:06d}.parquet"))
+            if replace_all and os.path.exists(self.wal_path):
+                os.remove(self.wal_path)
+
+    @staticmethod
+    def promote_numeric(cols: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        """Parse properties JSON once and add numeric:<key> columns.
+
+        A key is promoted only when EVERY present value coerces with
+        ``float`` — so the promoted column reproduces the JSON fallback
+        exactly (uncoercible values keep the key on the JSON path, matching
+        other backends' behavior including its errors)."""
+        parsed = [json.loads(p) if p else {} for p in cols["properties"]]
+        candidates: set = set()
+        rejected: set = set()
+        for p in parsed:
+            for k, v in p.items():
+                if _value_coercible(v):
+                    candidates.add(k)
+                else:
+                    rejected.add(k)
+        out = dict(cols)
+        for k in candidates - rejected:
+            out[f"numeric:{k}"] = np.array(
+                [_coerce_numeric(p[k]) if k in p else np.nan for p in parsed],
+                dtype=np.float64,
+            )
+        return out
+
     def compact(self, force: bool = False):
-        """Fold WAL into a new immutable part.
+        """Fold WAL into a new immutable part (numeric keys promoted).
 
         The threshold check is a stat() on the WAL file — callers can invoke
         this after every write without paying a parse of the WAL.
         """
         if not force and self.wal_bytes() < WAL_COMPACT_BYTES:
             return
-        import pyarrow as pa
-        import pyarrow.parquet as pq
-
         with self.lock:
             wal = self.read_wal()
             if not wal:
                 return
             cols = self.read_columns()  # parts + wal merged, deletes applied
-            order = np.argsort(cols["event_time"], kind="stable")
-            table = pa.table(
-                {
-                    c: (cols[c][order].tolist())
-                    for c in _SCHEMA_COLS
-                }
-            )
-            seq = len(self.part_paths())
-            tmp = os.path.join(self.dir, f".tmp-events-{seq:06d}.parquet")
-            pq.write_table(table, tmp)
-            # the new part holds EVERYTHING: replace old parts + wal
-            for p in self.part_paths():
-                os.remove(p)
-            os.replace(tmp, os.path.join(self.dir, f"events-{seq:06d}.parquet"))
-            if os.path.exists(self.wal_path):
-                os.remove(self.wal_path)
+            cols = {k: v for k, v in cols.items() if not k.startswith("numeric:")}
+            cols = self.promote_numeric(cols)
+            self.write_part(cols, replace_all=True)
 
     def all_ids(self) -> set:
         """Live event ids only — id-column scans, no full materialization."""
@@ -230,6 +342,24 @@ class _Namespace:
         with self.lock:
             if self.exists():
                 shutil.rmtree(self.dir)
+
+
+class _LazyJsonTags(Sequence):
+    """Row-aligned tag tuples decoded from JSON strings on access."""
+
+    __slots__ = ("_raw",)
+
+    def __init__(self, raw: np.ndarray):
+        self._raw = raw
+
+    def __len__(self) -> int:
+        return len(self._raw)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        raw = self._raw[int(i)]
+        return tuple(json.loads(raw)) if raw else ()
 
 
 class ParquetLEvents(base.LEvents):
@@ -382,6 +512,11 @@ class ParquetPEvents(base.PEvents):
                 )
         idx = np.nonzero(mask)[0]
         order = idx[np.argsort(cols["event_time"][idx], kind="stable")]
+        numeric = {
+            k[8:]: cols[k][order]
+            for k in cols
+            if k.startswith("numeric:")
+        }
         return EventBatch(
             event=cols["event"][order],
             entity_type=cols["entity_type"][order],
@@ -389,15 +524,38 @@ class ParquetPEvents(base.PEvents):
             target_entity_type=cols["target_entity_type"][order],
             target_entity_id=cols["target_entity_id"][order],
             event_time=cols["event_time"][order],
-            properties=[json.loads(cols["properties"][i]) for i in order],
+            # JSON decoded lazily per row; numeric keys served columnar
+            properties=LazyJsonProperties(cols["properties"][order]),
             event_id=cols["id"][order],
-            tags=[tuple(json.loads(cols["tags"][i])) for i in order],
+            tags=_LazyJsonTags(cols["tags"][order]),
             pr_id=cols["pr_id"][order],
             creation_time=cols["creation_time"][order],
+            numeric_properties=numeric or None,
         )
 
+    # events per write() call above which a part is written directly —
+    # bulk imports skip the WAL entirely
+    DIRECT_PART_THRESHOLD = 10_000
+
     def write(self, events, app_id, channel_id=None) -> None:
-        self._l.batch_insert(list(events), app_id, channel_id)
+        events = list(events)
+        if len(events) < self.DIRECT_PART_THRESHOLD:
+            self._l.batch_insert(events, app_id, channel_id)
+            return
+        rows = [
+            _event_to_row(e, e.event_id or new_event_id()) for e in events
+        ]
+        cols: dict[str, np.ndarray] = {}
+        for c in _SCHEMA_COLS:
+            if c in ("event_time", "creation_time"):
+                cols[c] = np.array([r[c] for r in rows], dtype=np.float64)
+            else:
+                arr = np.empty(len(rows), dtype=object)
+                for j, r in enumerate(rows):
+                    arr[j] = r[c]
+                cols[c] = arr
+        ns = _Namespace(self.root, app_id, channel_id)
+        ns.write_part(ns.promote_numeric(cols))
 
     def delete(self, event_ids, app_id, channel_id=None) -> None:
         ns = _Namespace(self.root, app_id, channel_id)

@@ -60,6 +60,54 @@ class TestParquetCompaction:
         assert len(list(le.find(1))) == 20
 
 
+class TestParquetNumericPromotion:
+    def test_mixed_parts_fall_back_to_json(self, tmp_path):
+        """A part written WITHOUT promoted columns must not shadow real JSON
+        values with defaults when mixed with promoted parts."""
+        from predictionio_tpu.data.storage.parquet import (
+            ParquetPEvents,
+            _Namespace,
+            _SCHEMA_COLS,
+            _event_to_row,
+        )
+        import numpy as np
+
+        pe = ParquetPEvents(path=str(tmp_path))
+        ns = _Namespace(str(tmp_path), 1, None)
+        # old-style part: no pnum columns, rating=5.0 in JSON
+        rows = [_event_to_row(ev("rate", "u1", props={"rating": 5.0}), "e1")]
+        cols = {}
+        for c in _SCHEMA_COLS:
+            arr = np.empty(1, object)
+            arr[0] = rows[0][c]
+            cols[c] = (
+                arr.astype(np.float64)
+                if c in ("event_time", "creation_time")
+                else arr
+            )
+        ns.write_part(cols)  # NOT promoted
+        # new-style bulk part with promotion
+        pe.write(
+            [ev("rate", f"u{i}", props={"rating": 3.0}) for i in range(10_001)], 1
+        )
+        batch = pe.find(1)
+        ratings = batch.property_column("rating", 1.0)
+        assert 5.0 in ratings and 1.0 not in ratings
+
+    def test_string_numbers_promote_consistently(self, tmp_path):
+        """String-encoded numbers coerce identically to the JSON fallback."""
+        from predictionio_tpu.data.storage.parquet import ParquetPEvents
+
+        pe = ParquetPEvents(path=str(tmp_path))
+        events = [ev("rate", f"u{i}", props={"rating": "4.5"}) for i in range(6000)]
+        events += [ev("rate", f"v{i}", props={"rating": 2.0}) for i in range(6000)]
+        pe.write(events, 1)
+        batch = pe.find(1)
+        assert batch.numeric_properties and "rating" in batch.numeric_properties
+        ratings = batch.property_column("rating", 1.0)
+        assert set(np.unique(ratings)) == {4.5, 2.0}
+
+
 class TestSelfCleaning:
     def test_compress_dedup_window(self, storage):
         le = storage.get_l_events()
