@@ -29,9 +29,14 @@ import numpy as np
 from predictionio_tpu.data.batch import EventBatch, LazyJsonProperties
 from predictionio_tpu.data.event import DataMap, Event, new_event_id
 from predictionio_tpu.data.storage import base
-from predictionio_tpu.data.storage.memory import match_event
-
 UTC = _dt.timezone.utc
+
+
+def _ts(d: _dt.datetime) -> float:
+    """Epoch seconds; naive datetimes are interpreted as UTC."""
+    if d.tzinfo is None:
+        d = d.replace(tzinfo=UTC)
+    return d.timestamp()
 
 WAL_COMPACT_BYTES = 4_000_000  # size-based trigger, stat()-checked per write
 
@@ -441,23 +446,45 @@ class ParquetLEvents(base.LEvents):
         limit=None,
         reversed: bool = False,
     ) -> Iterable[Event]:
+        # filter on COLUMNS (vectorized), materialize only matching rows —
+        # serving-time lookups touch a handful of rows, not the whole store
         cols = self._ns(app_id, channel_id).read_columns()
-        events = [
-            _row_to_event({c: cols[c][i] for c in _SCHEMA_COLS})
-            for i in range(len(cols["id"]))
-        ]
-        events = [
-            e
-            for e in events
-            if match_event(
-                e, start_time, until_time, entity_type, entity_id,
-                event_names, target_entity_type, target_entity_id,
+        n = len(cols["id"])
+        mask = np.ones(n, dtype=bool)
+        if start_time is not None:
+            mask &= cols["event_time"] >= _ts(start_time)
+        if until_time is not None:
+            mask &= cols["event_time"] < _ts(until_time)
+        if entity_type is not None:
+            mask &= cols["entity_type"] == entity_type
+        if entity_id is not None:
+            mask &= cols["entity_id"] == entity_id
+        if event_names is not None:
+            allowed = set(event_names)
+            mask &= np.fromiter(
+                (e in allowed for e in cols["event"]), dtype=bool, count=n
             )
-        ]
-        events.sort(key=lambda e: (e.event_time, e.creation_time), reverse=reversed)
+        for key, val in (
+            ("target_entity_type", target_entity_type),
+            ("target_entity_id", target_entity_id),
+        ):
+            if val is not None:
+                want = None if val == "None" else val
+                mask &= np.fromiter(
+                    (v == want for v in cols[key]), dtype=bool, count=n
+                )
+        idx = np.nonzero(mask)[0]
+        order = np.lexsort(
+            (cols["creation_time"][idx], cols["event_time"][idx])
+        )
+        if reversed:
+            order = order[::-1]
+        idx = idx[order]
         if limit is not None and limit >= 0:
-            events = events[:limit]
-        return events
+            idx = idx[:limit]
+        return [
+            _row_to_event({c: cols[c][i] for c in _SCHEMA_COLS}) for i in idx
+        ]
 
 
 class ParquetPEvents(base.PEvents):
@@ -483,15 +510,9 @@ class ParquetPEvents(base.PEvents):
         n = len(cols["id"])
         mask = np.ones(n, dtype=bool)
         if start_time is not None:
-            t = start_time.timestamp() if start_time.tzinfo else start_time.replace(
-                tzinfo=UTC
-            ).timestamp()
-            mask &= cols["event_time"] >= t
+            mask &= cols["event_time"] >= _ts(start_time)
         if until_time is not None:
-            t = until_time.timestamp() if until_time.tzinfo else until_time.replace(
-                tzinfo=UTC
-            ).timestamp()
-            mask &= cols["event_time"] < t
+            mask &= cols["event_time"] < _ts(until_time)
         if entity_type is not None:
             mask &= cols["entity_type"] == entity_type
         if entity_id is not None:
