@@ -37,7 +37,6 @@ from predictionio_tpu.core import (
     Params,
 )
 from predictionio_tpu.core.controller import SanityCheck
-from predictionio_tpu.data.batch import Interactions
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.data.store import LEventStore, PEventStore
 from predictionio_tpu.models.cooccurrence import (

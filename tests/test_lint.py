@@ -1,0 +1,71 @@
+"""Style gate: no unused imports, everything compiles.
+
+Parity role: the reference's scalastyle gate in tests/unit.sh:30-35 — a
+cheap hygiene check run with the unit suite.
+"""
+
+import ast
+import os
+
+import pytest
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "predictionio_tpu")
+
+
+def iter_modules():
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if not d.startswith("__")]
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def unused_imports(path: str) -> list[str]:
+    src = open(path).read()
+    tree = ast.parse(src)
+    imported: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imported[(a.asname or a.name).split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name != "*":
+                    imported[a.asname or a.name] = node.lineno
+    used = set()
+    for node in ast.walk(tree):
+        n = node
+        while isinstance(n, ast.Attribute):
+            n = n.value
+        if isinstance(n, ast.Name):
+            used.add(n.id)
+    in_all = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__" for t in node.targets
+            )
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant):
+                    in_all.add(elt.value)
+    return [
+        f"{path}:{lineno}: unused import {name}"
+        for name, lineno in imported.items()
+        if name not in used and name not in in_all
+    ]
+
+
+def test_no_unused_imports():
+    issues = [issue for path in iter_modules() for issue in unused_imports(path)]
+    assert not issues, "\n".join(issues)
+
+
+def test_all_modules_parse():
+    for path in iter_modules():
+        ast.parse(open(path).read(), filename=path)
