@@ -260,6 +260,29 @@ class PEvents(abc.ABC):
         )
         return _fold_properties(batch, required)
 
+    def find_interactions(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        rating_key: Optional[str] = None,
+        default_rating: float = 1.0,
+    ):
+        """Bulk (user, item, rating, t) triples for training reads.
+
+        Default: ``find`` + ``EventBatch.interactions``. Columnar drivers
+        override with zero-row-materialization fast paths.
+        """
+        return self.find(
+            app_id,
+            channel_id=channel_id,
+            entity_type=entity_type,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+        ).interactions(rating_key=rating_key, default_rating=default_rating)
+
     @abc.abstractmethod
     def write(
         self, events: Iterable[Event], app_id: int, channel_id: Optional[int] = None

@@ -85,6 +85,33 @@ class PEventStore:
         )
 
     @staticmethod
+    def find_interactions(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        entity_type: str = "user",
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: str = "item",
+        rating_key: Optional[str] = None,
+        default_rating: float = 1.0,
+    ):
+        """Bulk (user, item, rating, t) triples ready for the mesh.
+
+        Storage drivers with a columnar fast path (parquet) build these at
+        Arrow speed without materializing row objects; others go through
+        ``find().interactions()``.
+        """
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        return get_storage().get_p_events().find_interactions(
+            app_id,
+            channel_id=channel_id,
+            entity_type=entity_type,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            rating_key=rating_key,
+            default_rating=default_rating,
+        )
+
+    @staticmethod
     def aggregate_properties(
         app_name: str,
         entity_type: str,

@@ -70,13 +70,14 @@ class SequentialDataSource(DataSource):
     params_cls = SeqDataSourceParams
 
     def read_training(self, ctx) -> TrainingData:
-        batch = PEventStore.find(
-            self.params.appName,
-            entity_type="user",
-            event_names=list(self.params.eventNames),
-            target_entity_type="item",
+        return TrainingData(
+            interactions=PEventStore.find_interactions(
+                self.params.appName,
+                entity_type="user",
+                event_names=list(self.params.eventNames),
+                target_entity_type="item",
+            )
         )
-        return TrainingData(interactions=batch.interactions(rating_key=None))
 
 
 @dataclasses.dataclass

@@ -88,13 +88,13 @@ class SimilarProductDataSource(DataSource):
     params_cls = DataSourceParams
 
     def read_training(self, ctx) -> TrainingData:
-        batch = PEventStore.find(
+        inter = PEventStore.find_interactions(
             self.params.appName,
             entity_type="user",
             event_names=list(self.params.eventNames),
             target_entity_type="item",
+            rating_key=self.params.ratingKey,
         )
-        inter = batch.interactions(rating_key=self.params.ratingKey)
         props = PEventStore.aggregate_properties(self.params.appName, "item")
         item_categories = {
             item_id: set(pm.get("categories") or [])

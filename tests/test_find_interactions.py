@@ -1,0 +1,120 @@
+"""find_interactions fast path: parquet Arrow-native vs generic equivalence."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event
+
+UTC = dt.timezone.utc
+T0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+
+
+def seed_events(n_users=30, n_items=12):
+    rng = np.random.default_rng(0)
+    events = []
+    for u in range(n_users):
+        for i in rng.choice(n_items, 4, replace=False):
+            events.append(
+                Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties={"rating": float(rng.integers(1, 6))},
+                      event_time=T0 + dt.timedelta(seconds=u * 100 + int(i)))
+            )
+    # noise: other event/entity types must be filtered out
+    events.append(Event(event="$set", entity_type="user", entity_id="u0",
+                        properties={"x": 1}, event_time=T0))
+    events.append(Event(event="rate", entity_type="admin", entity_id="a0",
+                        target_entity_type="item", target_entity_id="i0",
+                        event_time=T0))
+    return events
+
+
+def canon(inter):
+    rows = sorted(
+        (inter.user_map.inverse[int(u)], inter.item_map.inverse[int(i)], float(r))
+        for u, i, r in zip(inter.user, inter.item, inter.rating)
+    )
+    return rows
+
+
+class TestFindInteractions:
+    def test_parquet_fast_path_matches_generic(self, tmp_path):
+        from predictionio_tpu.data.storage.parquet import ParquetPEvents
+
+        pe = ParquetPEvents(path=str(tmp_path))
+        pe.write(seed_events(), 1)
+        fast = pe.find_interactions(
+            1, entity_type="user", event_names=["rate"],
+            target_entity_type="item", rating_key="rating",
+        )
+        generic = pe.find(
+            1, entity_type="user", event_names=["rate"],
+            target_entity_type="item",
+        ).interactions(rating_key="rating")
+        assert len(fast) == len(generic) > 0
+        assert canon(fast) == canon(generic)
+
+    def test_store_facade_dispatches(self, storage, tmp_path):
+        from predictionio_tpu.data import store as store_mod
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.data.store import PEventStore
+
+        store_mod.set_storage(storage)
+        try:
+            app_id = storage.get_meta_data_apps().insert(App(0, "fiapp"))
+            le = storage.get_l_events()
+            le.init(app_id)
+            le.batch_insert(seed_events(), app_id)
+            inter = PEventStore.find_interactions(
+                "fiapp", event_names=["rate"], rating_key="rating"
+            )
+            assert len(inter) == 120
+            assert inter.n_users == 30 and inter.n_items == 12
+        finally:
+            store_mod.set_storage(None)
+
+    def test_mixed_parts_without_pnum_use_json(self, tmp_path):
+        """A part lacking the promoted rating column must not default-shadow
+        real JSON ratings on the fast path (per-part intersection rule)."""
+        from predictionio_tpu.data.storage.parquet import (
+            ParquetPEvents,
+            _Namespace,
+            _SCHEMA_COLS,
+            _event_to_row,
+        )
+
+        pe = ParquetPEvents(path=str(tmp_path))
+        ns = _Namespace(str(tmp_path), 1, None)
+        row = _event_to_row(
+            Event(event="rate", entity_type="user", entity_id="uX",
+                  target_entity_type="item", target_entity_id="iX",
+                  properties={"rating": 2.0}, event_time=T0),
+            "eX",
+        )
+        cols = {}
+        for c in _SCHEMA_COLS:
+            arr = np.empty(1, object)
+            arr[0] = row[c]
+            cols[c] = (
+                arr.astype(np.float64)
+                if c in ("event_time", "creation_time")
+                else arr
+            )
+        ns.write_part(cols)  # no pnum columns
+        pe.write(seed_events()[:120] * 100, 1)  # promoted part
+        inter = pe.find_interactions(
+            1, entity_type="user", event_names=["rate"],
+            target_entity_type="item", rating_key="rating",
+        )
+        ux = inter.user_map["uX"]
+        got = inter.rating[inter.user == ux]
+        assert got.tolist() == [2.0]  # from JSON, not default 1.0
+
+    def test_empty_namespace(self, tmp_path):
+        from predictionio_tpu.data.storage.parquet import ParquetPEvents
+
+        pe = ParquetPEvents(path=str(tmp_path))
+        inter = pe.find_interactions(1, event_names=["rate"])
+        assert len(inter) == 0
