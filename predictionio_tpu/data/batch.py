@@ -239,6 +239,42 @@ class EventBatch:
         )
 
 
+def merge_interactions(parts: "Sequence[Interactions]") -> "Interactions":
+    """Concatenate Interactions with differing id maps into shared maps.
+
+    Each part's codes are remapped through its uniques (small arrays), so
+    merging N bulk reads (e.g. one per event type, different weights) stays
+    O(rows) with no per-row Python.
+    """
+    parts = [p for p in parts if len(p)]
+    if not parts:
+        raise ValueError("nothing to merge")
+    if len(parts) == 1:
+        return parts[0]
+    user_map = BiMap.string_int(
+        np.concatenate([np.array(list(p.user_map.keys()), object) for p in parts])
+    )
+    item_map = BiMap.string_int(
+        np.concatenate([np.array(list(p.item_map.keys()), object) for p in parts])
+    )
+    users, items, ratings, ts = [], [], [], []
+    for p in parts:
+        u_remap = user_map.to_index_array(list(p.user_map.keys()))
+        i_remap = item_map.to_index_array(list(p.item_map.keys()))
+        users.append(u_remap[p.user].astype(np.int32))
+        items.append(i_remap[p.item].astype(np.int32))
+        ratings.append(p.rating)
+        ts.append(p.t)
+    return Interactions(
+        user=np.concatenate(users),
+        item=np.concatenate(items),
+        rating=np.concatenate(ratings),
+        t=np.concatenate(ts),
+        user_map=user_map,
+        item_map=item_map,
+    )
+
+
 class EntityMap:
     """Entity ids ↔ indices plus their property snapshots.
 

@@ -35,7 +35,7 @@ from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.core.evaluation import EngineParamsGenerator, Evaluation
 from predictionio_tpu.core.self_cleaning import SelfCleaningDataSource
 from predictionio_tpu.core.metrics import OptionAverageMetric
-from predictionio_tpu.data.batch import Interactions
+from predictionio_tpu.data.batch import Interactions, merge_interactions
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.models.als import ALSConfig, ALSModel, ALSScorer, train_als
 from predictionio_tpu.parallel.mesh import MeshContext
@@ -95,29 +95,31 @@ class RecommendationDataSource(SelfCleaningDataSource, DataSource):
     BUY_WEIGHT = 4.0  # parity: buy events count as rating 4.0
 
     def _read_interactions(self) -> Interactions:
-        batch = PEventStore.find(
+        # one columnar read per event type (fast path on parquet), merged
+        # with shared id maps; buys weigh BUY_WEIGHT like the reference
+        parts = []
+        rate = PEventStore.find_interactions(
             self.params.appName,
             entity_type="user",
-            event_names=["rate", "buy"],
+            event_names=["rate"],
             target_entity_type="item",
+            rating_key="rating",
+            default_rating=self.BUY_WEIGHT,
         )
-        ratings = batch.property_column("rating", self.BUY_WEIGHT).astype(np.float32)
-        is_buy = batch.event == "buy"
-        ratings[is_buy.astype(bool)] = self.BUY_WEIGHT
-        user_map, item_map = batch.entity_bimap(), batch.target_bimap()
-        users = user_map.to_index_array(batch.entity_id)
-        items = item_map.to_index_array(
-            ["" if t is None else t for t in batch.target_entity_id]
+        if len(rate):
+            parts.append(rate)
+        buy = PEventStore.find_interactions(
+            self.params.appName,
+            entity_type="user",
+            event_names=["buy"],
+            target_entity_type="item",
+            default_rating=self.BUY_WEIGHT,
         )
-        ok = (users >= 0) & (items >= 0)
-        return Interactions(
-            user=users[ok].astype(np.int32),
-            item=items[ok].astype(np.int32),
-            rating=ratings[ok],
-            t=batch.event_time[ok],
-            user_map=user_map,
-            item_map=item_map,
-        )
+        if len(buy):
+            parts.append(buy)
+        if not parts:
+            return rate  # empty Interactions with empty maps
+        return merge_interactions(parts)
 
     def read_training(self, ctx) -> TrainingData:
         self.clean_persisted_events()  # no-op without an eventWindow param

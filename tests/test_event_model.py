@@ -130,6 +130,23 @@ class TestEventBatch:
         # u0 rated i0 with 0.0 at t=0
         assert inter.rating[0] == 0.0
 
+    def test_merge_interactions_shared_maps(self):
+        from predictionio_tpu.data.batch import merge_interactions
+
+        a = EventBatch.from_events(
+            [ev("rate", "u1", {"rating": 2.0}, t=0, target="iA")]
+        ).interactions(rating_key="rating")
+        b = EventBatch.from_events(
+            [ev("buy", "u2", t=1, target="iA"), ev("buy", "u1", t=2, target="iB")]
+        ).interactions(default_rating=4.0)
+        m = merge_interactions([a, b])
+        assert len(m) == 3 and m.n_users == 2 and m.n_items == 2
+        # u1's rate of iA kept its 2.0; buys carry 4.0; ids shared
+        u1, iA = m.user_map["u1"], m.item_map["iA"]
+        r = m.rating[(m.user == u1) & (m.item == iA)]
+        assert r.tolist() == [2.0]
+        assert sorted(m.rating.tolist()) == [2.0, 4.0, 4.0]
+
     def test_to_dataframe(self):
         events = [ev("rate", "u1", {"rating": 4.0}, t=1, target="i1")]
         df = EventBatch.from_events(events).to_dataframe()
