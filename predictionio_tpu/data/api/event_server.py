@@ -177,6 +177,17 @@ class EventServer:
                 limit = int(p.get("limit", 20))
             except ValueError:
                 return json_response(400, {"message": "limit must be an integer"})
+            if p.get("reversed") == "true" and not (
+                p.get("entityType") and p.get("entityId")
+            ):
+                # parity: EventServer.scala:299-302
+                return json_response(
+                    400,
+                    {
+                        "message": "the parameter reversed can only be used "
+                        "with both entityType and entityId specified."
+                    },
+                )
             try:
                 events = self.storage.get_l_events().find(
                     auth["app_id"],

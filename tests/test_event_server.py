@@ -146,6 +146,23 @@ class TestEventAPI:
         )
         assert status == 404
 
+    def test_reversed_requires_entity(self, server):
+        # parity: EventServer.scala:299-302
+        key = server["key"]
+        status, body = call(
+            "GET", server["base"] + f"/events.json?accessKey={key}&reversed=true"
+        )
+        assert status == 400 and "reversed" in body["message"]
+        url = server["base"] + f"/events.json?accessKey={key}"
+        call("POST", url, dict(EV, entityId="rev1"))
+        status, _ = call(
+            "GET",
+            server["base"]
+            + f"/events.json?accessKey={key}&entityType=user&entityId=rev1"
+            "&reversed=true",
+        )
+        assert status == 200
+
     def test_channel_isolation(self, server):
         base, key = server["base"], server["key"]
         call("POST", base + f"/events.json?accessKey={key}&channel=live",
