@@ -69,6 +69,97 @@ class TestExplicitALS:
         assert np.linalg.norm(hi.user_factors) < np.linalg.norm(lo.user_factors)
 
 
+def dense_reference_half_step(V, users, items, ratings, n_users, reg,
+                              implicit=False, alpha=1.0):
+    """Straight-from-the-paper dense solve for U given V (numpy, no jax)."""
+    k = V.shape[1]
+    U = np.zeros((n_users, k), np.float64)
+    Vd = V.astype(np.float64)
+    G = Vd.T @ Vd
+    for u in range(n_users):
+        sel = users == u
+        Vi = Vd[items[sel]]
+        r = ratings[sel].astype(np.float64)
+        if implicit:
+            # Hu-Koren-Volinsky: (G + Vi^T (C-I) Vi + reg I) x = Vi^T C 1
+            C = alpha * r
+            A = G + Vi.T @ (Vi * C[:, None]) + reg * np.eye(k)
+            b = Vi.T @ (1.0 + C)
+        else:
+            # ALS-WR: (Vi^T Vi + reg*n_u I) x = Vi^T r
+            A = Vi.T @ Vi + (reg * len(r) + 1e-6) * np.eye(k)
+            b = Vi.T @ r
+        U[u] = np.linalg.solve(A, b)
+    return U
+
+
+class TestNumericalEquivalence:
+    """The sharded half-step equals the textbook dense solve exactly."""
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_half_step_matches_dense_reference(self, ctx, implicit):
+        from predictionio_tpu.models import als as als_mod
+
+        rng = np.random.default_rng(0)
+        n_users, n_items, k = 16, 12, 3
+        users = rng.integers(0, n_users, 80).astype(np.int64)
+        items = rng.integers(0, n_items, 80).astype(np.int64)
+        ratings = rng.uniform(1, 5, 80).astype(np.float32)
+        V0 = rng.normal(size=(n_items, k)).astype(np.float32)
+
+        inter = Interactions(
+            user=users.astype(np.int32), item=items.astype(np.int32),
+            rating=ratings, t=np.zeros(80),
+            user_map=BiMap.string_int(f"u{i}" for i in range(n_users)),
+            item_map=BiMap.string_int(f"i{i}" for i in range(n_items)),
+        )
+        cfg = ALSConfig(rank=k, iterations=1, reg=0.1,
+                        implicit=implicit, alpha=2.0)
+        # run ONE U-half-step through the sharded machinery by seeding V:
+        # monkeypatch init so U starts anywhere and V starts at V0, then
+        # compare the U produced by iteration 1's first half-step. We can
+        # recover it because after a full step U depends only on V0.
+        import jax
+
+        n_shards = ctx.axis_size("data")
+        n_users_pad = als_mod.pad_to_multiple(n_users, n_shards)
+        n_items_pad = als_mod.pad_to_multiple(n_items, n_shards)
+        ub = als_mod._make_blocks(users, items, ratings, n_users_pad, n_shards)
+        V_pad = np.zeros((n_items_pad, k), np.float32)
+        V_pad[:n_items] = V0
+        from functools import partial
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        import jax.numpy as jnp
+
+        kernel = partial(
+            als_mod._half_step_local, per_shard=ub.per_shard, rank=k,
+            reg=cfg.reg, implicit=implicit, alpha=cfg.alpha,
+        )
+        solve = shard_map(
+            kernel, mesh=ctx.mesh,
+            in_specs=(P("data"), P("data"), P("data"), P("data"), P(), P()),
+            out_specs=P("data", None),
+        )
+        gram = jnp.asarray(V_pad.T @ V_pad) if implicit else jnp.zeros((k, k))
+        U_sharded = np.asarray(
+            solve(
+                jnp.asarray(ub.local), jnp.asarray(ub.other),
+                jnp.asarray(ub.rating), jnp.asarray(ub.mask),
+                jnp.asarray(V_pad), gram.astype(jnp.float32),
+            )
+        )[:n_users]
+        U_ref = dense_reference_half_step(
+            V0, users, items, ratings, n_users, cfg.reg,
+            implicit=implicit, alpha=cfg.alpha,
+        )
+        # users with no ratings: sharded gives ~0 (eps ridge); exclude
+        has = np.isin(np.arange(n_users), users)
+        np.testing.assert_allclose(
+            U_sharded[has], U_ref[has], rtol=2e-4, atol=2e-5
+        )
+
+
 class TestImplicitALS:
     def test_ranks_observed_items_higher(self, ctx):
         # Two user groups with disjoint item tastes; implicit ALS must rank
