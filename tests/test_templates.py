@@ -71,6 +71,32 @@ class TestClassificationTemplate:
             assert hi.label == "premium", type(algo).__name__
             assert lo.label == "basic", type(algo).__name__
 
+    def test_run_evaluation_grid(self, app, ctx):
+        """ClassificationEvaluation end-to-end through run_evaluation."""
+        from predictionio_tpu.core.evaluation import run_evaluation
+        from predictionio_tpu.templates import classification as cls_mod
+
+        self.seed_users(app["le"], app["app_id"])
+
+        class AppEval(cls_mod.ClassificationEvaluation):
+            def __init__(self):
+                super().__init__(app_name="tapp", smoothing_grid=(0.5, 2.0))
+
+        # expose at module level for dotted-path resolution
+        cls_mod.AppEval = AppEval
+        try:
+            result = run_evaluation(
+                "predictionio_tpu.templates.classification.AppEval",
+                storage=app["storage"],
+            )
+            assert 0.0 <= result.best_score <= 1.0
+            inst = app["storage"].get_meta_data_evaluation_instances().get(
+                result.instance_id
+            )
+            assert inst.status == "EVALCOMPLETED"
+        finally:
+            del cls_mod.AppEval
+
     def test_evaluation_accuracy(self, app, ctx):
         from predictionio_tpu.templates.classification import (
             Accuracy,
