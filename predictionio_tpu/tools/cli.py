@@ -449,6 +449,19 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_loadtest(args) -> int:
+    from predictionio_tpu.tools.loadtest import run_loadtest
+
+    result = run_loadtest(
+        url=f"http://{args.ip}:{args.port}",
+        query=json.loads(args.query),
+        requests=args.requests,
+        concurrency=args.concurrency,
+    )
+    print(json.dumps(result))
+    return 0 if result["errors"] == 0 else 1
+
+
 def cmd_upgrade(args) -> int:
     # parity: Console "upgrade" verb — storage schemas here are
     # self-migrating (CREATE IF NOT EXISTS), so this is informational
@@ -585,6 +598,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ip", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=9000)
     sp.set_defaults(func=cmd_dashboard)
+
+    sp = sub.add_parser("loadtest")
+    sp.add_argument("--ip", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8000)
+    sp.add_argument("--query", default='{"user": "u1", "num": 10}')
+    sp.add_argument("--requests", type=int, default=200)
+    sp.add_argument("--concurrency", type=int, default=8)
+    sp.set_defaults(func=cmd_loadtest)
 
     sub.add_parser("upgrade").set_defaults(func=cmd_upgrade)
 

@@ -1,0 +1,77 @@
+"""Serving load test: concurrent queries against a deployed engine.
+
+The p50-predict-latency companion to bench.py's training throughput
+(BASELINE.md headline metrics). Fires N concurrent workers at
+``/queries.json`` and reports client-side latency quantiles + QPS; the
+server's own histogram (its ``GET /`` route) gives the service-side view.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+
+def run_loadtest(
+    url: str,
+    query: dict,
+    requests: int = 200,
+    concurrency: int = 8,
+    timeout: float = 30.0,
+) -> dict:
+    latencies: list[float] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+    counter = {"next": 0}
+
+    payload = json.dumps(query).encode()
+
+    def worker():
+        while True:
+            with lock:
+                if counter["next"] >= requests:
+                    return
+                counter["next"] += 1
+            req = urllib.request.Request(
+                f"{url}/queries.json",
+                data=payload,
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    r.read()
+                with lock:
+                    latencies.append(time.perf_counter() - t0)
+            except Exception as e:
+                with lock:
+                    errors.append(str(e))
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    latencies.sort()
+
+    def q(p: float) -> float:
+        if not latencies:
+            return float("nan")
+        return latencies[min(int(p * len(latencies)), len(latencies) - 1)] * 1e3
+
+    return {
+        "requests": requests,
+        "concurrency": concurrency,
+        "ok": len(latencies),
+        "errors": len(errors),
+        "wallSec": round(wall, 3),
+        "qps": round(len(latencies) / wall, 1) if wall > 0 else 0.0,
+        "p50Ms": round(q(0.50), 3),
+        "p90Ms": round(q(0.90), 3),
+        "p99Ms": round(q(0.99), 3),
+    }

@@ -272,6 +272,29 @@ class TestMicroBatching:
             qs.stop()
 
 
+class TestLoadtest:
+    def test_loadtest_reports(self, trained):
+        from predictionio_tpu.serving.query_server import QueryServer
+        from predictionio_tpu.tools.loadtest import run_loadtest
+
+        qs = QueryServer(
+            trained["engine"], storage=trained["storage"], ctx=trained["ctx"]
+        )
+        port = qs.start("127.0.0.1", 0)
+        try:
+            result = run_loadtest(
+                f"http://127.0.0.1:{port}",
+                {"user": "u1", "num": 3},
+                requests=40,
+                concurrency=4,
+            )
+            assert result["ok"] == 40 and result["errors"] == 0
+            assert result["qps"] > 0 and result["p50Ms"] > 0
+            assert result["p50Ms"] <= result["p99Ms"]
+        finally:
+            qs.stop()
+
+
 class TestBatchPredict:
     def test_batch_predict_file(self, trained, tmp_path):
         inp = tmp_path / "queries.json"
