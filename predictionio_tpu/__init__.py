@@ -19,3 +19,24 @@ Layer map (mirrors reference SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    """Lazy top-level conveniences (no heavy imports at package load)."""
+    if name in ("Event", "DataMap", "BiMap"):
+        from predictionio_tpu import data
+
+        return getattr(data, name)
+    if name == "Storage":
+        from predictionio_tpu.data.storage import Storage
+
+        return Storage
+    if name in ("Engine", "EngineFactory", "EngineParams"):
+        from predictionio_tpu import core
+
+        return getattr(core, name)
+    if name == "MeshContext":
+        from predictionio_tpu.parallel import MeshContext
+
+        return MeshContext
+    raise AttributeError(f"module 'predictionio_tpu' has no attribute {name!r}")
