@@ -20,23 +20,34 @@ Layer map (mirrors reference SURVEY.md §1):
 
 __version__ = "0.1.0"
 
+# Lazy top-level conveniences (no heavy imports at package load).
+_LAZY_EXPORTS = {
+    "Event": "predictionio_tpu.data",
+    "DataMap": "predictionio_tpu.data",
+    "BiMap": "predictionio_tpu.data",
+    "EventBatch": "predictionio_tpu.data.batch",
+    "Storage": "predictionio_tpu.data.storage",
+    "PEventStore": "predictionio_tpu.data.store",
+    "LEventStore": "predictionio_tpu.data.store",
+    "Engine": "predictionio_tpu.core",
+    "EngineFactory": "predictionio_tpu.core",
+    "EngineParams": "predictionio_tpu.core",
+    "MeshContext": "predictionio_tpu.parallel",
+}
+
 
 def __getattr__(name):
-    """Lazy top-level conveniences (no heavy imports at package load)."""
-    if name in ("Event", "DataMap", "BiMap"):
-        from predictionio_tpu import data
+    module_name = _LAZY_EXPORTS.get(name)
+    if module_name is None:
+        raise AttributeError(
+            f"module 'predictionio_tpu' has no attribute {name!r}"
+        )
+    import importlib
 
-        return getattr(data, name)
-    if name == "Storage":
-        from predictionio_tpu.data.storage import Storage
+    value = getattr(importlib.import_module(module_name), name)
+    globals()[name] = value  # cache: later accesses are plain lookups
+    return value
 
-        return Storage
-    if name in ("Engine", "EngineFactory", "EngineParams"):
-        from predictionio_tpu import core
 
-        return getattr(core, name)
-    if name == "MeshContext":
-        from predictionio_tpu.parallel import MeshContext
-
-        return MeshContext
-    raise AttributeError(f"module 'predictionio_tpu' has no attribute {name!r}")
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY_EXPORTS))
