@@ -83,13 +83,12 @@ class ECommDataSource(DataSource):
     params_cls = ECommDataSourceParams
 
     def read_training(self, ctx) -> TrainingData:
-        batch = PEventStore.find(
+        inter = PEventStore.find_interactions(
             self.params.appName,
             entity_type="user",
             event_names=list(self.params.eventNames),
             target_entity_type="item",
         )
-        inter = batch.interactions(rating_key=None)
         props = PEventStore.aggregate_properties(self.params.appName, "item")
         item_categories = {
             item_id: set(pm.get("categories") or []) for item_id, pm in props.items()
