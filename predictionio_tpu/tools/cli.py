@@ -115,6 +115,9 @@ def cmd_status(args) -> int:
     # parity: `pio status` → Storage.verifyAllDataObjects smoke check
     try:
         storage = _storage()
+        for repo, source in sorted(storage._repos.items()):
+            stype = storage._sources[source].get("type")
+            print(f"[INFO] {repo:<9} -> source {source} (type {stype})")
         ok = storage.verify_all_data_objects()
     except Exception as e:
         return _die(f"Unable to connect to all storage backends: {e}")
