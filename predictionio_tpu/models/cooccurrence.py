@@ -189,6 +189,128 @@ def llr_cross_scores(
     return jnp.where(C > 0, llr, 0.0)
 
 
+def cross_occurrence_topn(
+    ctx: MeshContext,
+    primary: "Interactions | BlockedIncidence",
+    secondary: Interactions,
+    n_items_primary: int,
+    n_items_secondary: int,
+    n_users: int,
+    k: int,
+    use_llr: bool = True,
+    primary_counts: Optional[np.ndarray] = None,
+    col_block: int = 4096,
+    exclude_diagonal: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Top-k correlated PRIMARY items per INDICATOR item, never holding C.
+
+    The dense (p_items × s_items) cross-occurrence matrix is ~14 GB at
+    MovieLens-25M scale; this computes it in COLUMN blocks (indicator items)
+    — ``C_blk = Σ_user-blocks A_pᵀ A_s[:, blk]`` — scores each block (LLR
+    optional) and takes the per-column top-k immediately, so peak memory is
+    O(p_items × col_block).  Exact: every column sees all its rows.
+
+    Returns (top_items (s_items, k) int32, top_scores (s_items, k) f32) —
+    rows indexed by INDICATOR item, matching ``llr.T`` + ``top_k`` on the
+    dense path.
+    """
+    n_users_pad = pad_to_multiple(n_users, _USER_BLOCK)
+    if isinstance(primary, Interactions):
+        primary = block_incidence(primary, n_users_pad)
+    p_pad = pad_to_multiple(n_items_primary, 128)
+    if primary_counts is None:
+        raise ValueError("primary_counts (distinct users per item) required")
+    pc_primary = jnp.asarray(
+        np.pad(primary_counts.astype(np.float32), (0, p_pad - n_items_primary))
+    )
+    sec_counts_full = distinct_item_counts(secondary, n_items_secondary)
+
+    k = min(k, n_items_primary)
+    out_items = np.zeros((n_items_secondary, k), np.int32)
+    out_scores = np.zeros((n_items_secondary, k), np.float32)
+
+    s_user = secondary.user.astype(np.int64)
+    s_item = secondary.item.astype(np.int64)
+
+    @partial(jax.jit, static_argnums=(6,))
+    def block_topk(pu, pi, pm, su, si, sm, width, p_counts, s_counts, total,
+                   col_start):
+        def body(C, xs):
+            bpu, bpi, bpm, bsu, bsi, bsm = xs
+            A_p = jnp.zeros((_USER_BLOCK, p_pad), jnp.bfloat16)
+            A_p = A_p.at[bpu, bpi].max(bpm.astype(jnp.bfloat16))
+            A_s = jnp.zeros((_USER_BLOCK, width), jnp.bfloat16)
+            A_s = A_s.at[bsu, bsi].max(bsm.astype(jnp.bfloat16))
+            return C + jnp.dot(A_p.T, A_s, preferred_element_type=jnp.float32), None
+
+        C0 = jnp.zeros((p_pad, width), jnp.float32)
+        C, _ = jax.lax.scan(body, C0, (pu, pi, pm, su, si, sm))
+        if use_llr:
+            scores = llr_cross_scores(C, p_counts, s_counts, total)
+        else:
+            scores = C
+        # mask padded primary rows so they never win
+        scores = jnp.where(
+            (jnp.arange(p_pad) < n_items_primary)[:, None], scores, -1.0
+        )
+        if exclude_diagonal:
+            diag = (
+                jnp.arange(p_pad)[:, None]
+                == (col_start + jnp.arange(width))[None, :]
+            )
+            scores = jnp.where(diag, -1.0, scores)
+        vals, idx = jax.lax.top_k(scores.T, k)  # per indicator column
+        return vals, idx
+
+    n_blocks = primary.n_blocks
+    for start in range(0, n_items_secondary, col_block):
+        width = min(col_block, n_items_secondary - start)
+        width_pad = pad_to_multiple(width, 128)
+        sel = (s_item >= start) & (s_item < start + width)
+        blk_inter = Interactions(
+            user=secondary.user[sel],
+            item=(s_item[sel] - start).astype(np.int32),
+            rating=secondary.rating[sel],
+            t=secondary.t[sel],
+            user_map=None,
+            item_map=None,
+        )
+        blocked_s = block_incidence(blk_inter, n_users_pad)
+        # align the two sides' per-user-block widths by padding to a common L
+        pL = primary.local_user.shape[1]
+        sL = blocked_s.local_user.shape[1]
+
+        def padded(b, L):
+            if b.local_user.shape[1] == L:
+                return b.local_user, b.item, b.mask
+            padw = L - b.local_user.shape[1]
+            return (
+                np.pad(b.local_user, ((0, 0), (0, padw))),
+                np.pad(b.item, ((0, 0), (0, padw))),
+                np.pad(b.mask, ((0, 0), (0, padw))),
+            )
+
+        L = max(pL, sL)
+        pu, pi, pm = padded(primary, L)
+        su, si, sm = padded(blocked_s, L)
+        s_counts = jnp.asarray(
+            np.pad(
+                sec_counts_full[start : start + width].astype(np.float32),
+                (0, width_pad - width),
+            )
+        )
+        vals, idx = block_topk(
+            jnp.asarray(pu), jnp.asarray(pi), jnp.asarray(pm),
+            jnp.asarray(su), jnp.asarray(si), jnp.asarray(sm),
+            width_pad, pc_primary, s_counts, float(n_users), start,
+        )
+        out_scores[start : start + width] = np.asarray(vals)[:width]
+        out_items[start : start + width] = np.asarray(idx)[:width]
+    # zero out non-positive scores like the dense path's s > 0 filter
+    out_scores = np.maximum(out_scores, 0.0)
+    return out_items, out_scores
+
+
 def llr_scores(C: jnp.ndarray, n_users: Optional[int] = None) -> jnp.ndarray:
     """LLR rescoring of a SELF co-occurrence matrix: marginals come from the
     diagonal (= distinct users per item).  Pass ``n_users``; without it the

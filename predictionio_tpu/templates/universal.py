@@ -43,6 +43,7 @@ from predictionio_tpu.models.cooccurrence import (
     _USER_BLOCK,
     block_incidence,
     cross_occurrence_matrix,
+    cross_occurrence_topn,
     distinct_item_counts,
     llr_cross_scores,
 )
@@ -138,6 +139,10 @@ class URModel:
 class URAlgorithm(Algorithm):
     params_cls = URAlgorithmParams
 
+    # above this catalog size the dense (items × items) matrix is blocked
+    # column-wise (it would be ~14 GB at MovieLens-25M's 59k items)
+    DENSE_ITEM_LIMIT = 16_384
+
     def train(self, ctx, pd: TrainingData) -> URModel:
         primary = pd.per_event[pd.primary_event]
         n_items = len(pd.item_map)
@@ -146,11 +151,23 @@ class URAlgorithm(Algorithm):
         # block the primary side ONCE; reused for every indicator matmul
         primary_blocked = block_incidence(primary, n_users_pad)
         # LLR marginals = DISTINCT-user counts, matching binarized incidence
-        primary_counts = jnp.asarray(distinct_item_counts(primary, n_items))
+        primary_counts_np = distinct_item_counts(primary, n_items)
+        primary_counts = jnp.asarray(primary_counts_np)
+        k = min(self.params.maxCorrelatorsPerItem, n_items)
+        blocked_mode = n_items > self.DENSE_ITEM_LIMIT
         indicators = {}
         for name, inter in pd.per_event.items():
             if len(inter) == 0:
                 logger.warning("indicator %s has no events; skipped", name)
+                continue
+            if blocked_mode:
+                idx, vals = cross_occurrence_topn(
+                    ctx, primary_blocked, inter, n_items, n_items,
+                    n_users=n_users, k=k, use_llr=True,
+                    primary_counts=primary_counts_np,
+                    exclude_diagonal=(name == pd.primary_event),
+                )
+                indicators[name] = (idx, vals)
                 continue
             C = cross_occurrence_matrix(
                 ctx, primary_blocked, inter, n_items, n_items,
@@ -160,7 +177,6 @@ class URAlgorithm(Algorithm):
             llr = llr_cross_scores(C, primary_counts, counts_t, n_users)
             if name == pd.primary_event:
                 llr = llr - jnp.diag(jnp.diag(llr))  # self-pairs excluded
-            k = min(self.params.maxCorrelatorsPerItem, n_items)
             vals, idx = jax.lax.top_k(llr.T, k)  # row per INDICATOR item
             indicators[name] = (
                 np.asarray(idx, np.int32),

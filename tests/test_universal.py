@@ -59,6 +59,59 @@ class TestCrossOccurrence:
         assert llr[0, 1] == 0 and llr[2, 0] == 0  # zero co-occurrence → 0
 
 
+class TestBlockedTopN:
+    def test_matches_dense_path(self, ctx):
+        """Column-blocked top-N equals the dense LLR.T + top_k path exactly,
+        including multi-block splits and diagonal exclusion."""
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.data.batch import Interactions
+        from predictionio_tpu.data.bimap import BiMap
+        from predictionio_tpu.models.cooccurrence import (
+            cross_occurrence_matrix,
+            cross_occurrence_topn,
+            distinct_item_counts,
+            llr_cross_scores,
+        )
+
+        rng = np.random.default_rng(7)
+        n_users, n_items = 50, 40
+        rows = [
+            (u, i)
+            for u in range(n_users)
+            for i in rng.choice(n_items, 5, replace=False)
+        ]
+        u_, i_ = map(np.array, zip(*rows))
+        inter = Interactions(
+            user=u_.astype(np.int32), item=i_.astype(np.int32),
+            rating=np.ones(len(rows), np.float32), t=np.zeros(len(rows)),
+            user_map=BiMap.string_int(f"u{j}" for j in range(n_users)),
+            item_map=BiMap.string_int(f"i{j}" for j in range(n_items)),
+        )
+        pc = distinct_item_counts(inter, n_items)
+        k = 6
+        for excl in (False, True):
+            # dense reference
+            C = cross_occurrence_matrix(ctx, inter, inter, n_items, n_items)
+            llr = llr_cross_scores(
+                C, jnp.asarray(pc), jnp.asarray(pc), n_users
+            )
+            if excl:
+                llr = llr - jnp.diag(jnp.diag(llr))
+            dvals, didx = jax.lax.top_k(llr.T, k)
+            dvals = np.maximum(np.asarray(dvals), 0.0)
+            # blocked with a tiny col_block to force several blocks
+            bidx, bvals = cross_occurrence_topn(
+                ctx, inter, inter, n_items, n_items, n_users=n_users, k=k,
+                primary_counts=pc, col_block=16, exclude_diagonal=excl,
+            )
+            np.testing.assert_allclose(bvals, dvals, rtol=1e-4, atol=1e-5)
+            # where scores are positive the item ids must agree
+            pos = bvals > 1e-6
+            np.testing.assert_array_equal(bidx[pos], np.asarray(didx)[pos])
+
+
 @pytest.fixture()
 def seeded(storage):
     store_mod.set_storage(storage)
