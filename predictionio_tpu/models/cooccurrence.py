@@ -320,12 +320,36 @@ def llr_scores(C: jnp.ndarray, n_users: Optional[int] = None) -> jnp.ndarray:
     return llr_cross_scores(C, diag, diag, jnp.maximum(total, 1.0))
 
 
+# above this catalog size train_cooccurrence uses the column-blocked top-N
+# path (the dense items x items matrix would exceed HBM)
+DENSE_ITEM_LIMIT = 16_384
+
+
 def train_cooccurrence(
     ctx: MeshContext,
     interactions: Interactions,
     n: int = 20,
     use_llr: bool = False,
 ) -> CooccurrenceModel:
+    n_items_total = interactions.n_items
+    if n_items_total > DENSE_ITEM_LIMIT:
+        # self-case C is symmetric: per-column top-k == per-row top-k
+        pc = distinct_item_counts(interactions, n_items_total)
+        idx, vals = cross_occurrence_topn(
+            ctx,
+            interactions,
+            interactions,
+            n_items_total,
+            n_items_total,
+            n_users=interactions.n_users,
+            k=min(n, n_items_total),
+            use_llr=use_llr,
+            primary_counts=pc,
+            exclude_diagonal=True,
+        )
+        return CooccurrenceModel(
+            top_items=idx, top_scores=vals, item_map=interactions.item_map
+        )
     C = cooccurrence_matrix(ctx, interactions)
     scores = llr_scores(C, n_users=interactions.n_users) if use_llr else C
     n_items = scores.shape[0]

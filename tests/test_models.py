@@ -125,6 +125,22 @@ class TestCooccurrence:
         assert 0 not in idx
         assert idx[0] == 1 and scores[0] == 10
 
+    @pytest.mark.parametrize("use_llr", [False, True])
+    def test_blocked_mode_matches_dense(self, ctx, monkeypatch, use_llr):
+        from predictionio_tpu.models import cooccurrence as co_mod
+
+        rng = np.random.default_rng(3)
+        rows = [(u, i) for u in range(40) for i in rng.choice(25, 4, replace=False)]
+        inter = make_interactions(rows, 40, 25)
+        dense = co_mod.train_cooccurrence(ctx, inter, n=5, use_llr=use_llr)
+        monkeypatch.setattr(co_mod, "DENSE_ITEM_LIMIT", 1)  # force blocked
+        blocked = co_mod.train_cooccurrence(ctx, inter, n=5, use_llr=use_llr)
+        np.testing.assert_allclose(
+            blocked.top_scores, dense.top_scores, rtol=1e-4, atol=1e-5
+        )
+        pos = dense.top_scores > 1e-6
+        np.testing.assert_array_equal(blocked.top_items[pos], dense.top_items[pos])
+
     def test_llr_downweights_popular(self, ctx):
         C = np.array(
             [[50.0, 10.0, 2.0], [10.0, 60.0, 1.0], [2.0, 1.0, 4.0]], np.float32
