@@ -59,6 +59,10 @@ class ALSConfig:
     # training resumes from the latest step found there
     checkpoint_dir: Optional[str] = None
     checkpoint_interval: int = 5
+    # "bf16": gather the opposite factors and form outer products in
+    # bfloat16 (halves the gather's HBM traffic; normal equations still
+    # accumulate and solve in f32). Default full f32.
+    compute_dtype: str = "f32"
 
 
 @dataclasses.dataclass
@@ -149,7 +153,8 @@ _CHUNK = 65536
 
 
 def _half_step_local(
-    local, other, rating, mask, opp_full, gram, per_shard, rank, reg, implicit, alpha
+    local, other, rating, mask, opp_full, gram, per_shard, rank, reg, implicit,
+    alpha, bf16=False,
 ):
     """Runs per shard: normal equations + batched Cholesky for one block.
 
@@ -157,16 +162,20 @@ def _half_step_local(
     gram: VᵀV (k,k) for implicit mode, zeros otherwise.
     Accumulates A/b over rating chunks with lax.scan — peak memory is
     O(chunk·k² + per_shard·k²) instead of O(L·k²).
+    With bf16, the gather + outer products run in bfloat16 (half the HBM
+    traffic); A/b accumulate and solve in f32.
     """
     L = local.shape[0]
     chunk = min(L, _CHUNK)
     n_chunks = L // chunk
     eye = jnp.eye(rank, dtype=jnp.float32)
+    if bf16:
+        opp_full = opp_full.astype(jnp.bfloat16)
 
     def body(carry, xs):
         A, b, cnt = carry
         lo, ot, rt, w = xs
-        vs = opp_full[ot]  # (chunk, k) gather
+        vs = opp_full[ot].astype(jnp.float32)  # (chunk, k) gather
         if implicit:
             # A_u += Σ α·r · v vᵀ ;  b_u += Σ (1+α·r) · v   (p=1, c=1+αr)
             cw = alpha * rt * w
@@ -217,6 +226,7 @@ def _make_step(mesh, ub: _Blocks, ib: _Blocks, cfg: ALSConfig):
             reg=reg,
             implicit=implicit,
             alpha=alpha,
+            bf16=(cfg.compute_dtype == "bf16"),
         )
         return shard_map(
             kernel,

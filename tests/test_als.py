@@ -62,6 +62,15 @@ class TestExplicitALS:
         m2 = train_als(ctx, inter, ALSConfig(rank=3, iterations=3, seed=5))
         np.testing.assert_allclose(m1.user_factors, m2.user_factors, rtol=1e-4)
 
+    def test_bf16_compute_converges(self, ctx):
+        inter = synthetic_explicit()
+        model = train_als(
+            ctx, inter,
+            ALSConfig(rank=3, iterations=12, reg=0.001, compute_dtype="bf16"),
+        )
+        err = rmse(model, inter)
+        assert err < 0.08, f"bf16 rmse {err} too high"
+
     def test_regularization_shrinks_factors(self, ctx):
         inter = synthetic_explicit(n_users=20, n_items=15)
         lo = train_als(ctx, inter, ALSConfig(rank=3, iterations=5, reg=0.001))
