@@ -272,6 +272,52 @@ class TestMicroBatching:
             qs.stop()
 
 
+class TestFullyLoadedServer:
+    def test_batching_feedback_plugins_together(self, trained):
+        """All server features enabled at once behave correctly."""
+        from predictionio_tpu.data.api.event_server import EventServer
+        from predictionio_tpu.serving.query_server import QueryServer
+
+        storage = trained["storage"]
+        key = storage.get_meta_data_access_keys().insert(
+            AccessKey("", storage.get_meta_data_apps().get_by_name("qsapp").id, [])
+        )
+        es = EventServer(storage=storage)
+        es_port = es.start("127.0.0.1", 0)
+        qs = QueryServer(
+            trained["engine"],
+            storage=storage,
+            ctx=trained["ctx"],
+            batching=True,
+            feedback=True,
+            event_server_url=f"http://127.0.0.1:{es_port}",
+            access_key=key,
+            plugins=[UpperCasePlugin()],
+        )
+        port = qs.start("127.0.0.1", 0)
+        try:
+            status, res = call(
+                "POST", f"http://127.0.0.1:{port}/queries.json",
+                {"user": "u1", "num": 5},
+            )
+            assert status == 200
+            assert len(res["itemScores"]) == 1  # blocker truncated
+            assert "prId" in res  # feedback tagged
+            deadline = time.time() + 5
+            app_id = storage.get_meta_data_apps().get_by_name("qsapp").id
+            while time.time() < deadline:
+                fb = list(
+                    storage.get_l_events().find(app_id, event_names=["predict"])
+                )
+                if fb:
+                    break
+                time.sleep(0.05)
+            assert fb, "feedback event missing with batching enabled"
+        finally:
+            qs.stop()
+            es.stop()
+
+
 class TestLoadtest:
     def test_loadtest_reports(self, trained):
         from predictionio_tpu.serving.query_server import QueryServer
