@@ -29,26 +29,40 @@ def _channel_id(storage: Storage, app_id: int, channel: Optional[str]) -> Option
 def export_events(
     storage: Storage, app_id: int, output_path: str, channel: Optional[str] = None
 ) -> int:
+    """Stream the columnar bulk read out as JSON lines (rows built lazily)."""
     channel_id = _channel_id(storage, app_id, channel)
+    batch = storage.get_p_events().find(app_id, channel_id=channel_id)
     n = 0
     with open(output_path, "w") as f:
-        for e in storage.get_l_events().find(app_id, channel_id=channel_id):
+        for e in batch:  # EventBatch materializes one row at a time
             f.write(e.to_json() + "\n")
             n += 1
     return n
 
 
+IMPORT_CHUNK = 10_000
+
+
 def import_events(
     storage: Storage, app_id: int, input_path: str, channel: Optional[str] = None
 ) -> int:
+    """Chunked inserts: bounded memory however large the file is."""
     channel_id = _channel_id(storage, app_id, channel)
     le = storage.get_l_events()
     le.init(app_id, channel_id)
-    events = []
+    n = 0
+    chunk: list[Event] = []
     with open(input_path) as f:
         for line in f:
             line = line.strip()
-            if line:
-                events.append(Event.from_json(line))
-    le.batch_insert(events, app_id, channel_id)
-    return len(events)
+            if not line:
+                continue
+            chunk.append(Event.from_json(line))
+            if len(chunk) >= IMPORT_CHUNK:
+                le.batch_insert(chunk, app_id, channel_id)
+                n += len(chunk)
+                chunk = []
+    if chunk:
+        le.batch_insert(chunk, app_id, channel_id)
+        n += len(chunk)
+    return n
