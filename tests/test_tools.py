@@ -53,6 +53,12 @@ class TestCliAppCommands:
         assert run_cli("app", "delete", "myapp") == 0
         assert run_cli("app", "show", "myapp") == 1
 
+    def test_app_new_with_custom_access_key(self, cli_env, capsys):
+        assert run_cli("app", "new", "customkey", "--access-key", "MYKEY123") == 0
+        out = capsys.readouterr().out
+        assert "Access Key: MYKEY123" in out
+        assert Storage.instance().get_meta_data_access_keys().get("MYKEY123")
+
     def test_accesskey_commands(self, cli_env, capsys):
         run_cli("app", "new", "akapp")
         capsys.readouterr()
