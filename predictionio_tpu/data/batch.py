@@ -43,12 +43,16 @@ class LazyJsonProperties(Sequence):
         i = int(i)
         got = self._cache.get(i)
         if got is None:
-            import json
-
-            raw = self._raw[i]
-            got = json.loads(raw) if raw else {}
+            got = self.decode(i)
             self._cache[i] = got
         return got
+
+    def decode(self, i: int) -> dict:
+        """Decode one row WITHOUT caching (full-scan iteration stays O(1))."""
+        import json
+
+        raw = self._raw[int(i)]
+        return json.loads(raw) if raw else {}
 
     def subset(self, idx: np.ndarray) -> "LazyJsonProperties":
         return LazyJsonProperties(self._raw[idx])
@@ -117,6 +121,7 @@ class EventBatch:
         return len(self.event)
 
     def __iter__(self) -> Iterator[Event]:
+        lazy = isinstance(self.properties, LazyJsonProperties)
         for i in range(len(self)):
             yield Event(
                 event=self.event[i],
@@ -124,7 +129,10 @@ class EventBatch:
                 entity_id=self.entity_id[i],
                 target_entity_type=self.target_entity_type[i],
                 target_entity_id=self.target_entity_id[i],
-                properties=self.properties[i],
+                # full scans must not populate the per-row decode cache
+                properties=(
+                    self.properties.decode(i) if lazy else self.properties[i]
+                ),
                 event_time=float(self.event_time[i]),
                 tags=self.tags[i],
                 pr_id=self.pr_id[i],

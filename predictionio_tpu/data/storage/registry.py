@@ -146,6 +146,13 @@ class Storage:
             repos[repo] = src
         return repos
 
+    def repository_bindings(self) -> dict[str, tuple[str, str]]:
+        """repository → (source name, driver type), for status displays."""
+        return {
+            repo: (source, self._sources[source].get("type"))
+            for repo, source in self._repos.items()
+        }
+
     # -- DAO resolution (parity: Storage.getDataObject:310-359) ------------
     def get_data_object(self, repo: str, dao: str):
         key = (repo, dao)

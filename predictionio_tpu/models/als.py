@@ -59,10 +59,15 @@ class ALSConfig:
     # training resumes from the latest step found there
     checkpoint_dir: Optional[str] = None
     checkpoint_interval: int = 5
-    # "bf16": gather the opposite factors and form outer products in
-    # bfloat16 (halves the gather's HBM traffic; normal equations still
-    # accumulate and solve in f32). Default full f32.
+    # "bf16": store/gather the opposite factor matrix in bfloat16 (halves
+    # the gather + all-gather HBM traffic); all arithmetic stays f32.
     compute_dtype: str = "f32"
+
+    def __post_init__(self):
+        if self.compute_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"compute_dtype must be 'f32' or 'bf16', got {self.compute_dtype!r}"
+            )
 
 
 @dataclasses.dataclass
@@ -162,8 +167,8 @@ def _half_step_local(
     gram: VᵀV (k,k) for implicit mode, zeros otherwise.
     Accumulates A/b over rating chunks with lax.scan — peak memory is
     O(chunk·k² + per_shard·k²) instead of O(L·k²).
-    With bf16, the gather + outer products run in bfloat16 (half the HBM
-    traffic); A/b accumulate and solve in f32.
+    With bf16, the opposite factors are STORED and gathered in bfloat16
+    (half the HBM traffic); all arithmetic runs in f32.
     """
     L = local.shape[0]
     chunk = min(L, _CHUNK)

@@ -262,36 +262,47 @@ def cross_occurrence_topn(
         vals, idx = jax.lax.top_k(scores.T, k)  # per indicator column
         return vals, idx
 
-    n_blocks = primary.n_blocks
-    for start in range(0, n_items_secondary, col_block):
+    # sort secondary ONCE by item so each column block is a contiguous slice
+    s_order = np.argsort(s_item, kind="stable")
+    s_user_sorted = s_user[s_order]
+    s_item_sorted = s_item[s_order]
+    s_bounds = np.searchsorted(
+        s_item_sorted, np.arange(0, n_items_secondary + col_block, col_block)
+    )
+
+    def padded(b, L):
+        if b.local_user.shape[1] == L:
+            return b.local_user, b.item, b.mask
+        padw = L - b.local_user.shape[1]
+        return (
+            np.pad(b.local_user, ((0, 0), (0, padw))),
+            np.pad(b.item, ((0, 0), (0, padw))),
+            np.pad(b.mask, ((0, 0), (0, padw))),
+        )
+
+    # upload the (large, reused) primary side ONCE
+    pL = primary.local_user.shape[1]
+    primary_dev: dict[int, tuple] = {}
+
+    for bi, start in enumerate(range(0, n_items_secondary, col_block)):
         width = min(col_block, n_items_secondary - start)
         width_pad = pad_to_multiple(width, 128)
-        sel = (s_item >= start) & (s_item < start + width)
+        lo, hi = s_bounds[bi], s_bounds[bi + 1]
         blk_inter = Interactions(
-            user=secondary.user[sel],
-            item=(s_item[sel] - start).astype(np.int32),
-            rating=secondary.rating[sel],
-            t=secondary.t[sel],
+            user=s_user_sorted[lo:hi].astype(np.int32),
+            item=(s_item_sorted[lo:hi] - start).astype(np.int32),
+            rating=np.ones(hi - lo, np.float32),
+            t=np.zeros(hi - lo),
             user_map=None,
             item_map=None,
         )
         blocked_s = block_incidence(blk_inter, n_users_pad)
         # align the two sides' per-user-block widths by padding to a common L
-        pL = primary.local_user.shape[1]
-        sL = blocked_s.local_user.shape[1]
-
-        def padded(b, L):
-            if b.local_user.shape[1] == L:
-                return b.local_user, b.item, b.mask
-            padw = L - b.local_user.shape[1]
-            return (
-                np.pad(b.local_user, ((0, 0), (0, padw))),
-                np.pad(b.item, ((0, 0), (0, padw))),
-                np.pad(b.mask, ((0, 0), (0, padw))),
-            )
-
-        L = max(pL, sL)
-        pu, pi, pm = padded(primary, L)
+        L = max(pL, blocked_s.local_user.shape[1])
+        if L not in primary_dev:
+            pu, pi, pm = padded(primary, L)
+            primary_dev[L] = tuple(jnp.asarray(a) for a in (pu, pi, pm))
+        pu_d, pi_d, pm_d = primary_dev[L]
         su, si, sm = padded(blocked_s, L)
         s_counts = jnp.asarray(
             np.pad(
@@ -300,7 +311,7 @@ def cross_occurrence_topn(
             )
         )
         vals, idx = block_topk(
-            jnp.asarray(pu), jnp.asarray(pi), jnp.asarray(pm),
+            pu_d, pi_d, pm_d,
             jnp.asarray(su), jnp.asarray(si), jnp.asarray(sm),
             width_pad, pc_primary, s_counts, float(n_users), start,
         )

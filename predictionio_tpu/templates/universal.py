@@ -40,6 +40,7 @@ from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.data.store import LEventStore, PEventStore
 from predictionio_tpu.models.cooccurrence import (
+    DENSE_ITEM_LIMIT,
     _USER_BLOCK,
     block_incidence,
     cross_occurrence_matrix,
@@ -139,9 +140,9 @@ class URModel:
 class URAlgorithm(Algorithm):
     params_cls = URAlgorithmParams
 
-    # above this catalog size the dense (items × items) matrix is blocked
-    # column-wise (it would be ~14 GB at MovieLens-25M's 59k items)
-    DENSE_ITEM_LIMIT = 16_384
+    # shared threshold with models.cooccurrence (dense items×items matrix
+    # would be ~14 GB at MovieLens-25M's 59k items)
+    DENSE_ITEM_LIMIT = DENSE_ITEM_LIMIT
 
     def train(self, ctx, pd: TrainingData) -> URModel:
         primary = pd.per_event[pd.primary_event]

@@ -115,8 +115,7 @@ def cmd_status(args) -> int:
     # parity: `pio status` → Storage.verifyAllDataObjects smoke check
     try:
         storage = _storage()
-        for repo, source in sorted(storage._repos.items()):
-            stype = storage._sources[source].get("type")
+        for repo, (source, stype) in sorted(storage.repository_bindings().items()):
             print(f"[INFO] {repo:<9} -> source {source} (type {stype})")
         ok = storage.verify_all_data_objects()
     except Exception as e:
