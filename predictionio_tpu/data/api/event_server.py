@@ -333,11 +333,17 @@ def register_builtin_connectors() -> None:
         register_form_connector,
         register_json_connector,
     )
+    from predictionio_tpu.data.webhooks.examples import (
+        ExampleFormConnector,
+        ExampleJsonConnector,
+    )
     from predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
     from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
 
     register_json_connector("segmentio", SegmentIOConnector())
     register_form_connector("mailchimp", MailChimpConnector())
+    register_json_connector("examplejson", ExampleJsonConnector())
+    register_form_connector("exampleform", ExampleFormConnector())
 
 
 register_builtin_connectors()

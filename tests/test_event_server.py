@@ -282,6 +282,32 @@ class TestWebhooks:
         )
         assert status == 200
 
+    def test_example_connectors(self, server):
+        key = server["key"]
+        status, body = call(
+            "POST",
+            server["base"] + f"/webhooks/examplejson.json?accessKey={key}",
+            {"type": "like", "user": "ex-u", "item": "ex-i",
+             "time": "2026-02-01T00:00:00Z"},
+        )
+        assert status == 201
+        form = urllib.parse.urlencode(
+            {"type": "share", "userId": "ex-u2", "itemId": "ex-i2"}
+        )
+        req = urllib.request.Request(
+            server["base"] + f"/webhooks/exampleform.form?accessKey={key}",
+            data=form.encode(), method="POST",
+        )
+        req.add_header("Content-Type", "application/x-www-form-urlencoded")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 201
+        status, body = call(
+            "POST",
+            server["base"] + f"/webhooks/examplejson.json?accessKey={key}",
+            {"type": "like"},  # missing user
+        )
+        assert status == 400
+
     def test_mailchimp_form(self, server):
         form = urllib.parse.urlencode(
             {
