@@ -49,8 +49,8 @@ def wait_alive(url, timeout=60):
     raise TimeoutError(f"{url} never came alive")
 
 
-@pytest.fixture()
-def cli_ctx(tmp_path):
+@pytest.fixture(params=["sqlite", "parquet"])
+def cli_ctx(request, tmp_path):
     env = dict(os.environ)
     env.update(
         {
@@ -64,6 +64,15 @@ def cli_ctx(tmp_path):
             "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
         }
     )
+    if request.param == "parquet":
+        # events on the columnar store; metadata/models stay relational
+        env.update(
+            {
+                "PIO_STORAGE_SOURCES_PQ_TYPE": "parquet",
+                "PIO_STORAGE_SOURCES_PQ_PATH": str(tmp_path / "events"),
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PQ",
+            }
+        )
     procs = []
 
     def pio(*args, background=False):
