@@ -172,6 +172,26 @@ def test_persist_modes_deploy(app_with_events, tmp_path, monkeypatch, mode):
     assert len(res.itemScores) == 3
 
 
+def test_mesh_conf_round_trips_to_deploy(app_with_events):
+    """engine.json's mesh section is stored on the instance and deploy
+    reconstructs the same mesh topology."""
+    storage = app_with_events
+    engine = RecommendationEngine.apply()
+    ep = engine.params_from_variant(VARIANT)
+    ctx = MeshContext.create(conf={"mesh_axes": {"data": 4, "model": 2}})
+    assert dict(ctx.mesh.shape) == {"data": 4, "model": 2}
+    iid = run_train(engine, ep, VARIANT["engineFactory"], storage=storage, ctx=ctx)
+    inst = storage.get_meta_data_engine_instances().get(iid)
+    assert inst.mesh_conf == {"mesh_axes": {"data": 4, "model": 2}}
+    # deploy WITHOUT an explicit ctx: built from the instance's mesh_conf
+    from predictionio_tpu.data import store as store_mod
+
+    _, algorithms, serving, models = prepare_deploy(engine, inst, storage=storage)
+    q = serving.supplement(Query(user="u1", num=2))
+    res = serving.serve(q, [algorithms[0].predict(models[0], q)])
+    assert len(res.itemScores) == 2
+
+
 def test_event_window_compaction_on_read(app_with_events):
     """SelfCleaningDataSource hook: eventWindow compacts the store pre-read."""
     storage = app_with_events
