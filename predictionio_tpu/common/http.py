@@ -47,6 +47,14 @@ def json_response(status: int, obj: Any) -> Response:
     return Response(status=status, body=obj)
 
 
+class _Server(ThreadingHTTPServer):
+    # The stdlib default accept backlog (5) drops bursts of concurrent
+    # connects with ConnectionResetError; the reference's akka-http server
+    # has no such cliff, and `pio loadtest` needs >=64 concurrent.
+    request_queue_size = 128
+    daemon_threads = True
+
+
 class HttpService:
     """Route table + threaded server; handlers get Request, return Response."""
 
@@ -115,7 +123,11 @@ class HttpService:
                     resp = json_response(400, {"message": f"invalid JSON: {e}"})
                 except Exception as e:  # pragma: no cover - defensive
                     resp = json_response(500, {"message": str(e)})
-                self._send(resp)
+                try:
+                    self._send(resp)
+                except (BrokenPipeError, ConnectionResetError):
+                    # client went away mid-response; nothing to salvage
+                    self.close_connection = True
 
             def _send(self, resp: Response):
                 body = resp.body
@@ -149,8 +161,7 @@ class HttpService:
             def do_PUT(self):
                 self._handle("PUT")
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._server.daemon_threads = True
+        self._server = _Server((host, port), Handler)
         if cert_path:
             import ssl
 

@@ -214,7 +214,9 @@ class TestMicroBatching:
         p_plain = plain.start("127.0.0.1", 0)
         p_batch = batched.start("127.0.0.1", 0)
         try:
-            users = [f"u{i % 10}" for i in range(24)]
+            # 64 concurrent connects overflowed the stdlib default accept
+            # backlog (5) before common/http.py raised request_queue_size
+            users = [f"u{i % 10}" for i in range(64)]
             results = {}
 
             def fire(base, tag):
@@ -250,6 +252,62 @@ class TestMicroBatching:
         finally:
             plain.stop()
             batched.stop()
+
+    def test_plugins_see_supplemented_query_in_both_modes(self, trained):
+        """Plugins/feedback receive the serving-supplemented query whether or
+        not micro-batching is on (parity: CreateServer's single
+        supplement-then-serve pipeline)."""
+        import dataclasses as dc
+
+        from predictionio_tpu.serving.query_server import (
+            EngineServerPlugin,
+            QueryServer,
+        )
+
+        seen: dict[str, list] = {"plain": [], "batch": []}
+
+        def recorder(tag):
+            class Recorder(EngineServerPlugin):
+                name = f"recorder-{tag}"
+                plugin_type = EngineServerPlugin.OUTPUT_SNIFFER
+
+                def process(self, query, prediction, context):
+                    seen[tag].append(query)
+                    return prediction
+
+            return Recorder()
+
+        servers = []
+        try:
+            for tag, batching in (("plain", False), ("batch", True)):
+                qs = QueryServer(
+                    trained["engine"], storage=trained["storage"],
+                    ctx=trained["ctx"], plugins=[recorder(tag)],
+                    batching=batching, batch_window_ms=5,
+                )
+                # make supplement observable: tag the query it returns
+                serving = qs._deployed.serving
+                if not getattr(serving, "_test_patched", False):
+                    orig = serving.supplement
+                    serving.supplement = lambda q, _o=orig: dc.replace(
+                        _o(q), num=q.num + 1
+                    )
+                    serving._test_patched = True
+                port = qs.start("127.0.0.1", 0)
+                servers.append(qs)
+                status, _ = call(
+                    "POST", f"http://127.0.0.1:{port}/queries.json",
+                    {"user": "u1", "num": 3},
+                )
+                assert status == 200
+            assert len(seen["plain"]) == 1 and len(seen["batch"]) == 1
+            # both modes hand plugins the SUPPLEMENTED query, not the raw one
+            assert seen["plain"][0].num > 3
+            assert seen["batch"][0].num == seen["plain"][0].num
+            assert seen["batch"][0].user == seen["plain"][0].user
+        finally:
+            for qs in servers:
+                qs.stop()
 
     def test_batch_error_propagates_per_request(self, trained):
         from predictionio_tpu.serving.query_server import QueryServer
