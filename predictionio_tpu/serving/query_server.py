@@ -165,7 +165,10 @@ class QueryServer:
         out = []
         for i, (_, sq) in enumerate(supplemented):
             preds = [d[i] for d in per_algo if i in d]
-            out.append(deployed.serving.serve(sq, preds))
+            # pair the supplemented query with its prediction so the serving
+            # pipeline downstream of the batch (plugins, feedback) sees the
+            # same supplemented query as the unbatched path
+            out.append((sq, deployed.serving.serve(sq, preds)))
         return out
 
     # -- query hot loop (parity: CreateServer.scala:484-634) -----------------
@@ -175,9 +178,7 @@ class QueryServer:
             deployed = self._deployed
         query = bind_query(self.engine.query_cls, data)
         if self._batcher is not None:
-            prediction = self._batcher.submit(query)
-            # supplement ran inside the batch; plugins see the bound query
-            supplemented = query
+            supplemented, prediction = self._batcher.submit(query)
         else:
             supplemented = deployed.serving.supplement(query)
             predictions = [
