@@ -97,18 +97,71 @@ class FastEvalCache:
 
     Candidates sharing a params prefix (data source → preparator → algorithms)
     reuse read_eval folds and trained models instead of recomputing them.
+
+    Memory is bounded by prefix-scoped eviction: when the full candidate list
+    is known up front, each cache entry carries a refcount of the candidates
+    still needing it, and :meth:`release` drops folds/prepared/model entries
+    the moment no remaining candidate shares that prefix — so peak residency
+    tracks *live* prefixes, not the whole grid.
     """
 
-    def __init__(self, engine: Engine, ctx: MeshContext):
+    def __init__(
+        self,
+        engine: Engine,
+        ctx: MeshContext,
+        candidates: Optional[Sequence[EngineParams]] = None,
+    ):
         self.engine = engine
         self.ctx = ctx
         self._folds: dict[str, list] = {}
         self._prepared: dict[str, list] = {}
         self._models: dict[str, list] = {}
+        self._remaining: Optional[dict[str, dict[str, int]]] = None
+        if candidates is not None:
+            self._remaining = {"folds": {}, "prepared": {}, "models": {}}
+            for ep in candidates:
+                for level, key in zip(
+                    ("folds", "prepared", "models"), self.candidate_keys(ep)
+                ):
+                    counts = self._remaining[level]
+                    counts[key] = counts.get(key, 0) + 1
 
     @staticmethod
     def _key(*parts: Any) -> str:
         return json.dumps(parts, sort_keys=True, default=str)
+
+    def candidate_keys(self, ep: EngineParams) -> tuple[str, str, str]:
+        ds = params_to_json(ep.data_source_params)
+        prep = params_to_json(ep.preparator_params)
+        algos = [(n, params_to_json(p)) for n, p in ep.algorithm_params_list]
+        return (
+            self._key(ds),
+            self._key(ds, prep),
+            self._key(ds, prep, algos),
+        )
+
+    def release(self, ep: EngineParams) -> None:
+        """Candidate finished: evict any prefix no remaining candidate shares."""
+        if self._remaining is None:
+            return
+        stores = {
+            "folds": self._folds,
+            "prepared": self._prepared,
+            "models": self._models,
+        }
+        for level, key in zip(
+            ("folds", "prepared", "models"), self.candidate_keys(ep)
+        ):
+            counts = self._remaining[level]
+            if key in counts:
+                counts[key] -= 1
+                if counts[key] <= 0:
+                    del counts[key]
+                    stores[level].pop(key, None)
+
+    @property
+    def entry_count(self) -> int:
+        return len(self._folds) + len(self._prepared) + len(self._models)
 
     def folds(self, ds_params) -> list:
         key = self._key(params_to_json(ds_params))
@@ -162,13 +215,14 @@ class MetricEvaluator:
     ) -> EvaluationResult:
         if not engine_params_list:
             raise ValueError("engine_params_list is empty; nothing to evaluate")
-        cache = FastEvalCache(engine, ctx)
+        cache = FastEvalCache(engine, ctx, candidates=engine_params_list)
         results: list[MetricScores] = []
         best: Optional[MetricScores] = None
         for i, ep in enumerate(engine_params_list):
             qpas = self._eval_candidate(cache, engine, ctx, ep)
             score = self.metric.calculate(ctx, qpas)
             others = [m.calculate(ctx, qpas) for m in self.metrics]
+            cache.release(ep)
             ms = MetricScores(score, others, ep)
             results.append(ms)
             logger.info("candidate %d: %s = %s", i, self.metric.header, score)
