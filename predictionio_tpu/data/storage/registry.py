@@ -4,7 +4,8 @@ Parity: ``data/.../data/storage/Storage.scala:146-466``.  The configuration
 contract is preserved verbatim:
 
 * ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` — driver type of source <NAME>
-  (supported here: ``memory``, ``sqlite`` (alias ``jdbc``), ``localfs``);
+  (supported here: ``memory``, ``sqlite``, ``parquet``, ``localfs``, and
+  ``network`` — a remote ``pio storageserver`` shared by many hosts);
   any other key after the type becomes a constructor kwarg, e.g.
   ``PIO_STORAGE_SOURCES_PGSQL_PATH=/data/pio.sqlite`` → ``path=...``
   (parity: Storage.scala:158-223 sourcesPrefixFilter).
@@ -66,8 +67,22 @@ def _register_builtin():
         "EvaluationInstances": sqlite.SqliteEvaluationInstances,
     }
     register_driver("sqlite", sqlite_daos)
-    register_driver("jdbc", sqlite_daos)  # config-compat alias
     register_driver("localfs", {"Models": localfs.LocalFSModels})
+    from predictionio_tpu.data.storage import network
+
+    register_driver(
+        "network",
+        {
+            "LEvents": network.NetworkLEvents,
+            "PEvents": network.NetworkPEvents,
+            "Models": network.NetworkModels,
+            "Apps": network.NetworkApps,
+            "AccessKeys": network.NetworkAccessKeys,
+            "Channels": network.NetworkChannels,
+            "EngineInstances": network.NetworkEngineInstances,
+            "EvaluationInstances": network.NetworkEvaluationInstances,
+        },
+    )
     import importlib.util
 
     if importlib.util.find_spec("pyarrow") is not None:
@@ -161,6 +176,18 @@ class Storage:
         source_name = self._repos[repo]
         attrs = dict(self._sources[source_name])
         type_name = attrs.pop("type")
+        if type_name == "jdbc":
+            # No silent sqlite fallback: a reference pio-env.sh naming a
+            # networked JDBC/Postgres source must not quietly get a local
+            # file (round-1 ADVICE).  The equivalent capability here is the
+            # `network` driver against `pio storageserver`.
+            raise StorageError(
+                f"source {source_name!r}: TYPE=jdbc names a client/server SQL "
+                "database, which this build does not embed. Use TYPE=sqlite "
+                "for a single-host file store, or TYPE=network with "
+                f"PIO_STORAGE_SOURCES_{source_name}_URL=http://host:7077 "
+                "against `pio storageserver` for a shared data plane."
+            )
         if type_name not in DRIVERS:
             raise StorageError(f"unknown storage type {type_name!r}")
         if dao not in DRIVERS[type_name]:

@@ -384,6 +384,26 @@ def cmd_eventserver(args) -> int:
     return 0
 
 
+def cmd_storageserver(args) -> int:
+    """Serve the locally-configured storage to other hosts (network driver).
+
+    The data-plane service of the multi-host topology: run it on the host
+    owning the data; every other host sets TYPE=network + URL to this
+    address (parity role: the Postgres/HBase server in the reference stack).
+    """
+    from predictionio_tpu.data.storage.network import StorageServer
+
+    server = StorageServer(storage=_storage(), secret=args.secret)
+    port = server.start(args.ip, args.port, allow_insecure=args.allow_insecure,
+                        cert_path=args.cert_path, key_path=args.key_path)
+    print(f"[INFO] Storage Server is listening at http://{args.ip}:{port}")
+    try:
+        server.service.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
 def cmd_adminserver(args) -> int:
     from predictionio_tpu.tools.admin import AdminServer
 
@@ -590,6 +610,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--cert-path", default=None)
     sp.add_argument("--key-path", default=None)
     sp.set_defaults(func=cmd_eventserver)
+
+    sp = sub.add_parser("storageserver")
+    sp.add_argument("--ip", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=7077)
+    sp.add_argument("--secret", default=None)
+    sp.add_argument("--allow-insecure", action="store_true",
+                    help="serve without a secret on non-loopback interfaces")
+    sp.add_argument("--cert-path", default=None)
+    sp.add_argument("--key-path", default=None)
+    sp.set_defaults(func=cmd_storageserver)
 
     sp = sub.add_parser("adminserver")
     sp.add_argument("--ip", default="127.0.0.1")

@@ -49,7 +49,7 @@ def wait_alive(url, timeout=60):
     raise TimeoutError(f"{url} never came alive")
 
 
-@pytest.fixture(params=["sqlite", "parquet"])
+@pytest.fixture(params=["sqlite", "parquet", "network"])
 def cli_ctx(request, tmp_path):
     env = dict(os.environ)
     env.update(
@@ -74,6 +74,30 @@ def cli_ctx(request, tmp_path):
             }
         )
     procs = []
+    if request.param == "network":
+        # the full CLI lifecycle against a REMOTE data plane: a real
+        # `pio storageserver` process owns the sqlite files; every pio verb
+        # and server in the test talks to it over HTTP (multi-host topology)
+        ss_port = free_port()
+        server_env = dict(env)
+        server_env["PIO_STORAGE_SOURCES_DB_PATH"] = str(tmp_path / "server.sqlite")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.tools.cli",
+             "storageserver", "--ip", "127.0.0.1", "--port", str(ss_port)],
+            env=server_env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        procs.append(p)
+        wait_alive(f"http://127.0.0.1:{ss_port}/")
+        env.update(
+            {
+                "PIO_STORAGE_SOURCES_NET_TYPE": "network",
+                "PIO_STORAGE_SOURCES_NET_URL": f"http://127.0.0.1:{ss_port}",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+            }
+        )
 
     def pio(*args, background=False):
         cmd = [sys.executable, "-m", "predictionio_tpu.tools.cli", *args]

@@ -31,7 +31,7 @@ def ev(event, eid, t=0, target=None, props=None):
     )
 
 
-@pytest.fixture(params=["memory", "sqlite", "parquet"])
+@pytest.fixture(params=["memory", "sqlite", "parquet", "network"])
 def driver_env(request, tmp_path):
     name = "T" + uuid.uuid4().hex[:8].upper()
     env = {
@@ -40,6 +40,7 @@ def driver_env(request, tmp_path):
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": name,
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": name,
     }
+    server = None
     if request.param == "sqlite":
         env[f"PIO_STORAGE_SOURCES_{name}_PATH"] = str(tmp_path / "pio.sqlite")
     elif request.param == "parquet":
@@ -48,11 +49,30 @@ def driver_env(request, tmp_path):
         env[f"PIO_STORAGE_SOURCES_{name}META_TYPE"] = "memory"
         env["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = name + "META"
         env["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = name + "META"
+    elif request.param == "network":
+        # the same behavioral spec runs against a live storage server —
+        # the tier-2 "containerized backend" role (SURVEY.md §4)
+        from predictionio_tpu.data.storage.network import StorageServer
+
+        backing = name + "BACK"
+        server = StorageServer(
+            Storage(env={
+                f"PIO_STORAGE_SOURCES_{backing}_TYPE": "memory",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": backing,
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": backing,
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": backing,
+            })
+        )
+        port = server.start("127.0.0.1", 0)
+        env[f"PIO_STORAGE_SOURCES_{name}_URL"] = f"http://127.0.0.1:{port}"
     yield env
     from predictionio_tpu.data.storage import memory, sqlite
 
+    if server is not None:
+        server.stop()
     memory.reset_store(name)
     memory.reset_store(name + "META")
+    memory.reset_store(name + "BACK")
     if request.param == "sqlite":
         sqlite.close_db(str(tmp_path / "pio.sqlite"))
 
