@@ -449,13 +449,23 @@ class ALSScorer:
     Parity role: ``ALSModel.recommendProductsWithFilter``
     (``examples/scala-parallel-recommendation/blacklist-items/.../ALSModel.scala``)
     — but the score+filter+top-k runs as one jitted program, factors stay in
-    HBM between queries, and the exclusion set arrives as a device mask.
+    HBM between queries, and exclusion/candidate sets travel as small INDEX
+    arrays (padded to a few fixed bucket widths), scattered into the score
+    mask on device.  A dense per-query (n_items,) host mask would cost MBs
+    of upload per query at million-item catalogs over links with a fixed
+    readback floor; seen-sets/blacklists are typically hundreds of ids.
     """
 
     # Below this factor-matrix size, score on host: a few-μs numpy matvec
     # beats a device round trip for single queries (the reference's local
     # P2L models serve on the driver for the same reason).
     HOST_THRESHOLD = 2_000_000  # item_factors elements
+
+    # Filter index arrays are padded up to these widths so jit compiles a
+    # handful of variants, not one per distinct set size. Sets larger than
+    # the top bucket (rare: a user who has seen >32k items) fall back to
+    # the host path.
+    FILTER_BUCKETS = (0, 64, 512, 4096, 32768)
 
     def __init__(
         self,
@@ -489,9 +499,19 @@ class ALSScorer:
             self._k = min(max_k, self.n_items)
 
             @jax.jit
-            def _score(U, V, pad_mask, u_idx, exclude_mask):
+            def _score(U, V, pad_mask, u_idx, exclude_idx, candidate_idx,
+                       use_candidates):
                 scores = U[u_idx] @ V.T  # (rank,) @ (pad, rank)ᵀ → (pad,)
-                scores = jnp.where(pad_mask | exclude_mask, -1e30, scores)
+                # index buckets are padded with n_items_pad (out of range):
+                # mode="drop" makes the padding a no-op scatter
+                excl = jnp.zeros_like(pad_mask).at[exclude_idx].set(
+                    True, mode="drop"
+                )
+                keep = jnp.zeros_like(pad_mask).at[candidate_idx].set(
+                    True, mode="drop"
+                )
+                cand_excl = jnp.logical_and(~keep, use_candidates)
+                scores = jnp.where(pad_mask | excl | cand_excl, -1e30, scores)
                 return jax.lax.top_k(scores, self._k)
 
             self._score = _score
@@ -529,6 +549,19 @@ class ALSScorer:
         idx = np.take_along_axis(idx, order, axis=1)
         return idx, np.take_along_axis(row_scores, order, axis=1)
 
+    def _bucketed(self, items: Optional[np.ndarray]) -> Optional[np.ndarray]:
+        """Index set → sentinel-padded bucket array, or None if oversized."""
+        idx = (
+            np.asarray(items, np.int64)
+            if items is not None else np.empty(0, np.int64)
+        )
+        for width in self.FILTER_BUCKETS:
+            if len(idx) <= width:
+                out = np.full(width, self._n_items_pad, np.int64)
+                out[: len(idx)] = idx
+                return out
+        return None
+
     def recommend(
         self,
         user_idx: int,
@@ -537,22 +570,30 @@ class ALSScorer:
         candidate_items: Optional[np.ndarray] = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """(item_indices, scores) of the top ``num`` items for one user."""
-        mask = np.zeros(self._n_items_pad, bool)
-        if exclude_items is not None and len(exclude_items):
-            mask[np.asarray(exclude_items, np.int64)] = True
-        if candidate_items is not None:
-            keep = np.zeros(self._n_items_pad, bool)
-            keep[np.asarray(candidate_items, np.int64)] = True
-            mask |= ~keep
         k = min(max(num, 1), self.n_items)
+        excl_bucket = self._bucketed(exclude_items)
+        cand_bucket = self._bucketed(candidate_items)
         # num beyond the compiled top-k width serves exactly from host
-        # rather than silently truncating to max_k
-        if self.on_device and k <= self._k:
+        # rather than silently truncating to max_k; oversized filter sets
+        # (bucket overflow) also drop to host instead of a dense upload
+        if (
+            self.on_device and k <= self._k
+            and excl_bucket is not None and cand_bucket is not None
+        ):
             vals, idx = self._score(
-                self._U, self._V, self._pad_mask, user_idx, jnp.asarray(mask)
+                self._U, self._V, self._pad_mask, user_idx,
+                jnp.asarray(excl_bucket), jnp.asarray(cand_bucket),
+                jnp.asarray(candidate_items is not None),
             )
             vals, idx = np.asarray(vals)[:k], np.asarray(idx)[:k]
         else:
+            mask = np.zeros(self._n_items_pad, bool)
+            if exclude_items is not None and len(exclude_items):
+                mask[np.asarray(exclude_items, np.int64)] = True
+            if candidate_items is not None:
+                keep = np.zeros(self._n_items_pad, bool)
+                keep[np.asarray(candidate_items, np.int64)] = True
+                mask |= ~keep
             m = self.model
             scores = m.user_factors[user_idx] @ m.item_factors.T
             scores = np.where(mask[: self.n_items], -1e30, scores)

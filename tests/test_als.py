@@ -212,6 +212,38 @@ class TestALSScorer:
         idx3, _ = scorer.recommend(0, 3, candidate_items=np.array([1, 2, 3]))
         assert set(idx3) <= {1, 2, 3}
 
+    def test_device_path_matches_host_path_with_filters(self, ctx):
+        """The on-device scatter-of-indices filter (no dense per-query mask
+        upload) must rank identically to the host reference path, across
+        filter-bucket sizes including empty and multi-bucket."""
+        inter = synthetic_explicit(n_users=12, n_items=40)
+        model = train_als(ctx, inter, ALSConfig(rank=4, iterations=4))
+        host = ALSScorer(ctx, model, on_device=False)
+        dev = ALSScorer(ctx, model, on_device=True)
+        rng = np.random.default_rng(0)
+        cases = [
+            dict(),
+            dict(exclude_items=np.array([0])),
+            dict(exclude_items=rng.choice(40, 30, replace=False)),
+            dict(candidate_items=np.array([5, 6, 7, 8])),
+            dict(exclude_items=np.array([5, 6]),
+                 candidate_items=np.array([5, 6, 7, 8, 9])),
+            dict(candidate_items=np.arange(40)),  # full whitelist = no-op
+        ]
+        for kw in cases:
+            hi, hs = host.recommend(3, 4, **kw)
+            di, ds = dev.recommend(3, 4, **kw)
+            assert list(hi) == list(di), kw
+            np.testing.assert_allclose(hs, ds, rtol=1e-4)
+
+    def test_oversized_filter_set_falls_back_to_host(self, ctx):
+        inter = synthetic_explicit(n_users=6, n_items=20)
+        model = train_als(ctx, inter, ALSConfig(rank=2, iterations=2))
+        scorer = ALSScorer(ctx, model, on_device=True)
+        scorer.FILTER_BUCKETS = (0, 4)  # force overflow with 5 exclusions
+        idx, _ = scorer.recommend(0, 5, exclude_items=np.arange(5))
+        assert not set(idx) & set(range(5))
+
     def test_num_larger_than_items(self, ctx):
         inter = synthetic_explicit(n_users=5, n_items=4)
         model = train_als(ctx, inter, ALSConfig(rank=2, iterations=2))
