@@ -24,10 +24,16 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
 
 from predictionio_tpu.data.batch import Interactions
 from predictionio_tpu.data.bimap import BiMap
-from predictionio_tpu.parallel.mesh import MeshContext, pad_to_multiple
+from predictionio_tpu.parallel.mesh import (
+    MODEL_AXIS,
+    MeshContext,
+    pad_to_multiple,
+)
 
 _USER_BLOCK = 4096  # users per matmul block (A_b is USER_BLOCK × n_items)
 
@@ -59,14 +65,27 @@ class BlockedIncidence:
     n_blocks: int
 
 
-def block_incidence(inter: Interactions, n_users_pad: int) -> BlockedIncidence:
+def incidence_width(user: np.ndarray, n_users_pad: int) -> int:
+    """Per-user-block row width block_incidence would use — without building
+    the incidence arrays (lets callers size a shared width cheaply first)."""
+    counts = np.bincount(
+        user.astype(np.int64) // _USER_BLOCK,
+        minlength=n_users_pad // _USER_BLOCK,
+    )
+    return pad_to_multiple(int(counts.max()) if len(counts) else 1, 8)
+
+
+def block_incidence(
+    inter: Interactions, n_users_pad: int, width: Optional[int] = None
+) -> BlockedIncidence:
     n_blocks = n_users_pad // _USER_BLOCK
     order = np.argsort(inter.user, kind="stable")
     u = inter.user[order].astype(np.int64)
     i = inter.item[order].astype(np.int64)
     block_of = u // _USER_BLOCK
     counts = np.bincount(block_of, minlength=n_blocks)
-    width = pad_to_multiple(int(counts.max()) if len(counts) else 1, 8)
+    if width is None:
+        width = pad_to_multiple(int(counts.max()) if len(counts) else 1, 8)
     lu = np.zeros((n_blocks, width), np.int32)
     li = np.zeros((n_blocks, width), np.int32)
     lm = np.zeros((n_blocks, width), np.float32)
@@ -231,19 +250,24 @@ def cross_occurrence_topn(
 
     s_user = secondary.user.astype(np.int64)
     s_item = secondary.item.astype(np.int64)
+    width_pad = pad_to_multiple(min(col_block, n_items_secondary), 128)
+    total = float(n_users)
 
-    @partial(jax.jit, static_argnums=(6,))
-    def block_topk(pu, pi, pm, su, si, sm, width, p_counts, s_counts, total,
-                   col_start):
+    def block_kernel(pu, pi, pm, su, si, sm, p_counts, s_counts, col_start,
+                     varying=False):
+        """One column block: accumulate C over user blocks, score, top-k."""
+
         def body(C, xs):
             bpu, bpi, bpm, bsu, bsi, bsm = xs
             A_p = jnp.zeros((_USER_BLOCK, p_pad), jnp.bfloat16)
             A_p = A_p.at[bpu, bpi].max(bpm.astype(jnp.bfloat16))
-            A_s = jnp.zeros((_USER_BLOCK, width), jnp.bfloat16)
+            A_s = jnp.zeros((_USER_BLOCK, width_pad), jnp.bfloat16)
             A_s = A_s.at[bsu, bsi].max(bsm.astype(jnp.bfloat16))
             return C + jnp.dot(A_p.T, A_s, preferred_element_type=jnp.float32), None
 
-        C0 = jnp.zeros((p_pad, width), jnp.float32)
+        C0 = jnp.zeros((p_pad, width_pad), jnp.float32)
+        if varying:  # under shard_map the carry differs per model-axis peer
+            C0 = jax.lax.pcast(C0, MODEL_AXIS, to="varying")
         C, _ = jax.lax.scan(body, C0, (pu, pi, pm, su, si, sm))
         if use_llr:
             scores = llr_cross_scores(C, p_counts, s_counts, total)
@@ -256,7 +280,7 @@ def cross_occurrence_topn(
         if exclude_diagonal:
             diag = (
                 jnp.arange(p_pad)[:, None]
-                == (col_start + jnp.arange(width))[None, :]
+                == (col_start + jnp.arange(width_pad))[None, :]
             )
             scores = jnp.where(diag, -1.0, scores)
         vals, idx = jax.lax.top_k(scores.T, k)  # per indicator column
@@ -280,13 +304,18 @@ def cross_occurrence_topn(
             np.pad(b.mask, ((0, 0), (0, padw))),
         )
 
-    # upload the (large, reused) primary side ONCE
-    pL = primary.local_user.shape[1]
-    primary_dev: dict[int, tuple] = {}
+    # size ONE common user-block width first (cheap bincounts, no incidence
+    # arrays yet), then build each block lazily at that width as consumed —
+    # peak host memory stays one block (or one mesh group), not the catalog
+    starts = list(range(0, n_items_secondary, col_block))
+    L = primary.local_user.shape[1]
+    for bi in range(len(starts)):
+        lo, hi = s_bounds[bi], s_bounds[bi + 1]
+        L = max(L, incidence_width(s_user_sorted[lo:hi], n_users_pad))
 
-    for bi, start in enumerate(range(0, n_items_secondary, col_block)):
+    def build_block(bi: int):
+        start = starts[bi]
         width = min(col_block, n_items_secondary - start)
-        width_pad = pad_to_multiple(width, 128)
         lo, hi = s_bounds[bi], s_bounds[bi + 1]
         blk_inter = Interactions(
             user=s_user_sorted[lo:hi].astype(np.int32),
@@ -296,27 +325,64 @@ def cross_occurrence_topn(
             user_map=None,
             item_map=None,
         )
-        blocked_s = block_incidence(blk_inter, n_users_pad)
-        # align the two sides' per-user-block widths by padding to a common L
-        L = max(pL, blocked_s.local_user.shape[1])
-        if L not in primary_dev:
-            pu, pi, pm = padded(primary, L)
-            primary_dev[L] = tuple(jnp.asarray(a) for a in (pu, pi, pm))
-        pu_d, pi_d, pm_d = primary_dev[L]
-        su, si, sm = padded(blocked_s, L)
-        s_counts = jnp.asarray(
-            np.pad(
-                sec_counts_full[start : start + width].astype(np.float32),
-                (0, width_pad - width),
+        blocked_s = block_incidence(blk_inter, n_users_pad, width=L)
+        s_counts = np.pad(
+            sec_counts_full[start : start + width].astype(np.float32),
+            (0, width_pad - width),
+        )
+        return blocked_s, s_counts, start, width
+
+    pu, pi, pm = (jnp.asarray(a) for a in padded(primary, L))
+
+    n_model = ctx.axis_size(MODEL_AXIS)
+    if n_model > 1:
+        # 2-D mesh: indicator-column blocks ride the `model` axis — each
+        # device owns one block per round while the primary incidence is
+        # replicated; `data`-axis peers hold the same replica.  This is the
+        # tensor-style partition of the CCO output matrix (its columns).
+        sharded = shard_map(
+            lambda pu, pi, pm, su, si, sm, pc, sc, cs: tuple(
+                o[None] for o in block_kernel(
+                    pu, pi, pm, su[0], si[0], sm[0], pc, sc[0], cs[0],
+                    varying=True,
+                )
+            ),
+            mesh=ctx.mesh,
+            in_specs=(
+                P(), P(), P(),
+                P(MODEL_AXIS), P(MODEL_AXIS), P(MODEL_AXIS),
+                P(), P(MODEL_AXIS), P(MODEL_AXIS),
+            ),
+            out_specs=(P(MODEL_AXIS), P(MODEL_AXIS)),
+        )
+        run_group = jax.jit(sharded)
+        for g in range(0, len(starts), n_model):
+            group = [build_block(bi) for bi in range(g, min(g + n_model, len(starts)))]
+            real_n = len(group)
+            group = group + [group[-1]] * (n_model - real_n)  # results dropped
+            su = jnp.asarray(np.stack([b.local_user for b, *_ in group]))
+            si = jnp.asarray(np.stack([b.item for b, *_ in group]))
+            sm = jnp.asarray(np.stack([b.mask for b, *_ in group]))
+            sc = jnp.asarray(np.stack([c for _, c, *_ in group]))
+            cs = jnp.asarray(np.array([s for *_, s, _ in group], np.int32))
+            vals, idx = run_group(pu, pi, pm, su, si, sm, pc_primary, sc, cs)
+            vals, idx = np.asarray(vals), np.asarray(idx)
+            for j, (_, _, start, width) in enumerate(group[:real_n]):
+                out_scores[start : start + width] = vals[j, :width]
+                out_items[start : start + width] = idx[j, :width]
+    else:
+        run_block = jax.jit(block_kernel)
+        for bi in range(len(starts)):
+            blocked_s, s_counts, start, width = build_block(bi)
+            vals, idx = run_block(
+                pu, pi, pm,
+                jnp.asarray(blocked_s.local_user),
+                jnp.asarray(blocked_s.item),
+                jnp.asarray(blocked_s.mask),
+                pc_primary, jnp.asarray(s_counts), jnp.asarray(start),
             )
-        )
-        vals, idx = block_topk(
-            pu_d, pi_d, pm_d,
-            jnp.asarray(su), jnp.asarray(si), jnp.asarray(sm),
-            width_pad, pc_primary, s_counts, float(n_users), start,
-        )
-        out_scores[start : start + width] = np.asarray(vals)[:width]
-        out_items[start : start + width] = np.asarray(idx)[:width]
+            out_scores[start : start + width] = np.asarray(vals)[:width]
+            out_items[start : start + width] = np.asarray(idx)[:width]
     # zero out non-positive scores like the dense path's s > 0 filter
     out_scores = np.maximum(out_scores, 0.0)
     return out_items, out_scores
