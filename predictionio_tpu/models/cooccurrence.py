@@ -85,7 +85,7 @@ def block_incidence(
     block_of = u // _USER_BLOCK
     counts = np.bincount(block_of, minlength=n_blocks)
     if width is None:
-        width = pad_to_multiple(int(counts.max()) if len(counts) else 1, 8)
+        width = incidence_width(inter.user, n_users_pad)
     lu = np.zeros((n_blocks, width), np.int32)
     li = np.zeros((n_blocks, width), np.int32)
     lm = np.zeros((n_blocks, width), np.float32)

@@ -113,6 +113,12 @@ def _init_params(key, cfg: SASRecConfig, n_items: int) -> dict:
     return params
 
 
+def _use_flash(t: int) -> bool:
+    """Long blocks on TPU take the Pallas kernel; short blocks and CPU stay
+    dense (interpret-mode flash loses on CPU)."""
+    return t >= 256 and t % 128 == 0 and jax.default_backend() == "tpu"
+
+
 def _layer_norm(x, g):
     mu = x.mean(-1, keepdims=True)
     var = ((x - mu) ** 2).mean(-1, keepdims=True)
@@ -122,9 +128,9 @@ def _layer_norm(x, g):
 def _forward(params, seq, cfg: SASRecConfig, allow_flash: bool = False):
     """seq (B, T) int32 → hidden states (B, T, D).
 
-    allow_flash enables the Pallas flash kernel for long blocks on the
-    INFERENCE path only — the kernel has no VJP yet, so the training loss
-    always uses the dense (differentiable) attention.
+    allow_flash enables the Pallas flash kernel for long blocks on TPU —
+    training included: the kernel carries a custom VJP (recomputation-form
+    backward), so long-context training memory is O(T·D), not O(T²).
     """
     x = params["emb"][seq] + params["pos"][None, :, :]
     pad_mask = (seq == PAD)[:, :, None]
@@ -138,12 +144,7 @@ def _forward(params, seq, cfg: SASRecConfig, allow_flash: bool = False):
             return z.reshape(*z.shape[:-1], cfg.n_heads, h).swapaxes(-3, -2)
 
         t = seq.shape[-1]
-        if (
-            allow_flash
-            and t >= 256
-            and t % 128 == 0
-            and jax.default_backend() == "tpu"  # interp-mode flash loses on CPU
-        ):
+        if allow_flash and _use_flash(t):
             # long blocks: Pallas flash kernel (streams K/V through VMEM)
             from predictionio_tpu.ops.flash_attention import flash_attention
 
@@ -163,7 +164,9 @@ def _loss_fn(params, seq, cfg: SASRecConfig):
     masked out."""
     inputs = seq[:, :-1]
     targets = seq[:, 1:]
-    hidden = _forward(params, inputs, cfg)  # uses pos[0:T-1]
+    # flash path is differentiable (custom VJP); the gate inside _forward
+    # still keeps short blocks / CPU on dense attention
+    hidden = _forward(params, inputs, cfg, allow_flash=True)  # uses pos[0:T-1]
     logits = hidden @ params["emb"][1:].T  # (B, T-1, n_items); skip pad row
     mask = (targets != PAD) & (inputs != PAD)
     logp = jax.nn.log_softmax(logits, axis=-1)

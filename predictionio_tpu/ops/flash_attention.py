@@ -13,8 +13,15 @@ resides on-chip, so context length is bounded by HBM, not VMEM.
 back to interpret mode off-TPU, so the CPU test mesh exercises the identical
 kernel code.  Callers dispatch explicitly (see the gate in
 ``models/sequential.py``: dense attention off-TPU or for short blocks,
-``flash_attention`` for long blocks on TPU; no VJP yet, so training paths
-use the dense form).
+``flash_attention`` for long blocks on TPU — training included).
+
+Differentiable: a ``jax.custom_vjp`` supplies the standard
+recomputation-form backward (FlashAttention-2 style).  The forward kernel
+additionally emits the per-row logsumexp; the backward recomputes each
+(q_block, k_block) score tile from Q/K + logsumexp instead of storing the
+(T × T) probability matrix, as two Pallas kernels: dQ sweeps K blocks
+innermost (dq accumulates in VMEM), dK/dV sweeps Q blocks innermost.
+Training memory is O(T·D), not O(T²).
 """
 
 from __future__ import annotations
@@ -34,9 +41,19 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
+def _causal_mask(qi, ki, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return q_pos >= k_pos
+
+
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, causal: bool,
-    scale: float, block_q: int, block_k: int
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+    causal: bool, scale: float, block_q: int, block_k: int
 ):
     qi = pl.program_id(0)
     ki = pl.program_id(1)
@@ -53,13 +70,7 @@ def _flash_kernel(
     v = v_ref[...].astype(jnp.float32)
     s = q @ k.T  # MXU
     if causal:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(_causal_mask(qi, ki, block_q, block_k), s, NEG_INF)
     m_prev, l_prev = m_ref[...], l_ref[...]
     m_blk = jnp.max(s, axis=1)
     m_new = jnp.maximum(m_prev, m_blk)
@@ -71,15 +82,17 @@ def _flash_kernel(
 
     @pl.when(ki == n_k - 1)
     def _finalize():
-        o_ref[...] = (
-            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)[:, None]
-        ).astype(o_ref.dtype)
+        l_final = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / l_final[:, None]).astype(o_ref.dtype)
+        # per-row logsumexp, saved for the recomputation backward
+        lse_ref[...] = (m_ref[...] + jnp.log(l_final))[:, None]
 
 
 @functools.partial(
     jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret")
 )
-def _flash_2d(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_2d_res(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Forward returning (o, lse); lse feeds the recomputation backward."""
     t_q, d = q.shape
     t_kv = k.shape[0]
     grid = (t_q // block_q, t_kv // block_k)  # K innermost: accumulators carry
@@ -90,7 +103,7 @@ def _flash_2d(q, k, v, causal, scale, block_q, block_k, interpret):
         block_q=block_q,
         block_k=block_k,
     )
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -98,8 +111,14 @@ def _flash_2d(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((block_k, d), lambda qi, ki: (ki, 0)),
             pl.BlockSpec((block_k, d), lambda qi, ki: (ki, 0)),
         ],
-        out_specs=pl.BlockSpec((block_q, d), lambda qi, ki: (qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((t_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((block_q, d), lambda qi, ki: (qi, 0)),
+            pl.BlockSpec((block_q, 1), lambda qi, ki: (qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((t_q, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -107,6 +126,147 @@ def _flash_2d(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
+    return o, lse
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref, *,
+    causal: bool, scale: float, block_q: int, block_k: int
+):
+    qi = pl.program_id(0)
+    ki = pl.program_id(1)
+    n_k = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[...].astype(jnp.float32)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    s = (q * scale) @ k.T
+    if causal:
+        s = jnp.where(_causal_mask(qi, ki, block_q, block_k), s, NEG_INF)
+    p = jnp.exp(s - lse_ref[...])  # (block_q, block_k); masked rows → 0
+    dp = do @ v.T
+    ds = p * (dp - delta_ref[...])
+    acc_ref[...] += ds @ k
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[...] = (acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, causal: bool, scale: float, block_q: int, block_k: int
+):
+    ki = pl.program_id(0)
+    qi = pl.program_id(1)
+    n_q = pl.num_programs(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[...].astype(jnp.float32)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    s = (q * scale) @ k.T
+    if causal:
+        s = jnp.where(_causal_mask(qi, ki, block_q, block_k), s, NEG_INF)
+    p = jnp.exp(s - lse_ref[...])
+    dv_acc[...] += p.T @ do
+    dp = do @ v.T
+    ds = p * (dp - delta_ref[...])
+    dk_acc[...] += (ds.T @ q) * scale
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret")
+)
+def _flash_2d_bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                  interpret):
+    t_q, d = q.shape
+    t_kv = k.shape[0]
+    # D_i = Σ_d dO·O — the softmax-Jacobian row term (plain XLA, one pass)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    common = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+    q_specs = [
+        pl.BlockSpec((block_q, d), lambda qi, ki: (qi, 0)),
+        pl.BlockSpec((block_k, d), lambda qi, ki: (ki, 0)),
+        pl.BlockSpec((block_k, d), lambda qi, ki: (ki, 0)),
+        pl.BlockSpec((block_q, d), lambda qi, ki: (qi, 0)),
+        pl.BlockSpec((block_q, 1), lambda qi, ki: (qi, 0)),
+        pl.BlockSpec((block_q, 1), lambda qi, ki: (qi, 0)),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(t_q // block_q, t_kv // block_k),  # K innermost
+        in_specs=q_specs,
+        out_specs=pl.BlockSpec((block_q, d), lambda qi, ki: (qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    kv_specs = [
+        pl.BlockSpec((block_q, d), lambda ki, qi: (qi, 0)),
+        pl.BlockSpec((block_k, d), lambda ki, qi: (ki, 0)),
+        pl.BlockSpec((block_k, d), lambda ki, qi: (ki, 0)),
+        pl.BlockSpec((block_q, d), lambda ki, qi: (qi, 0)),
+        pl.BlockSpec((block_q, 1), lambda ki, qi: (qi, 0)),
+        pl.BlockSpec((block_q, 1), lambda ki, qi: (qi, 0)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(t_kv // block_k, t_q // block_q),  # Q innermost
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((block_k, d), lambda ki, qi: (ki, 0)),
+            pl.BlockSpec((block_k, d), lambda ki, qi: (ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((t_kv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_2d(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _flash_2d_res(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_2d_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _flash_2d_res(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_2d_vjp(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_2d_bwd(
+        q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret
+    )
+
+
+_flash_2d.defvjp(_flash_2d_fwd, _flash_2d_vjp)
 
 
 def flash_attention(

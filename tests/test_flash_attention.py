@@ -42,3 +42,103 @@ class TestFlashAttention:
         q, k, v = rand_qkv(rng, (100, 16))
         with pytest.raises(ValueError, match="divide"):
             flash_attention(q, k, v, block_q=64, block_k=64)
+
+
+class TestFlashBackward:
+    """The custom VJP (recomputation-form Pallas backward) must produce the
+    same gradients as differentiating dense attention."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_dense(self, causal):
+        import jax
+
+        rng = np.random.default_rng(4)
+        q, k, v = rand_qkv(rng, (256, 32))
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal)
+            return (o * np.cos(np.arange(32))).sum()  # non-uniform cotangent
+
+        def loss_dense(q, k, v):
+            o = full_attention(q, k, v, causal=causal)
+            return (o * np.cos(np.arange(32))).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gd, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                err_msg=f"d{name}",
+            )
+
+    def test_grads_multiblock_batched(self):
+        import jax
+
+        rng = np.random.default_rng(5)
+        q, k, v = rand_qkv(rng, (2, 2, 128, 16))
+
+        def loss(fn):
+            def go(q, k, v):
+                return (fn(q, k, v, causal=True) ** 2).sum()
+
+            return go
+
+        gf = jax.grad(loss(lambda *a, **kw: flash_attention(
+            *a, block_q=64, block_k=64, **kw)), argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss(full_attention), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+    def test_value_and_grad_jittable(self):
+        import jax
+
+        rng = np.random.default_rng(6)
+        q, k, v = rand_qkv(rng, (128, 16))
+
+        @jax.jit
+        def vg(q, k, v):
+            return jax.value_and_grad(
+                lambda q: flash_attention(q, k, v, causal=True).sum()
+            )(q)
+
+        val, g = vg(q, k, v)
+        assert np.isfinite(np.asarray(val))
+        assert g.shape == q.shape and np.all(np.isfinite(np.asarray(g)))
+
+
+class TestLongBlockTraining:
+    def test_sasrec_training_step_on_mesh_with_flash(self, monkeypatch):
+        """One SASRec grad step at a flash-eligible length (T>=256) over the
+        8-device mesh, with the TPU gate forced open so the Pallas VJP path
+        (interpret mode) actually computes the training gradients."""
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.models import sequential as seq_mod
+
+        # force the flash branch despite running on CPU (the kernel itself
+        # still auto-selects interpret mode off-TPU)
+        monkeypatch.setattr(
+            seq_mod, "_use_flash", lambda t: t >= 256 and t % 128 == 0
+        )
+        from predictionio_tpu.parallel.mesh import DATA_AXIS, MeshContext
+
+        ctx = MeshContext.create()  # 8 virtual devices over `data`
+        assert ctx.n_devices == 8
+        cfg = seq_mod.SASRecConfig(
+            d_model=16, n_heads=2, n_layers=1, max_len=256
+        )
+        params = seq_mod._init_params(jax.random.PRNGKey(0), cfg, n_items=50)
+        params = jax.device_put(params, ctx.replicated())
+        rng = np.random.default_rng(7)
+        batch = rng.integers(1, 51, size=(8, 257)).astype(np.int32)
+        batch[:, : 100] = 0  # some padding
+        sb = jax.device_put(jnp.asarray(batch), ctx.sharding(DATA_AXIS, None))
+        loss, grads = jax.jit(jax.value_and_grad(seq_mod._loss_fn),
+                              static_argnums=(2,))(params, sb, cfg)
+        assert np.isfinite(np.asarray(loss))
+        flat, _ = jax.tree.flatten(grads)
+        assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+        assert any(float(jnp.abs(g).max()) > 0 for g in flat)
