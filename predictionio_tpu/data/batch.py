@@ -337,3 +337,55 @@ class Interactions:
     @property
     def n_items(self) -> int:
         return len(self.item_map) if self.item_map is not None else int(self.item.max()) + 1
+
+    def subset(self, mask: np.ndarray) -> "Interactions":
+        """Row-select by boolean mask or index array; id maps carry over."""
+        return Interactions(
+            user=self.user[mask],
+            item=self.item[mask],
+            rating=self.rating[mask],
+            t=self.t[mask],
+            user_map=self.user_map,
+            item_map=self.item_map,
+        )
+
+    def drop_items(self, item_indices: np.ndarray) -> "Interactions":
+        """Remove the given items' rows AND compact both id spaces.
+
+        Unlike ``subset`` (which keeps the maps), dropped items leave
+        ``item_map`` entirely — and users whose every interaction involved a
+        dropped item leave ``user_map`` — so downstream models cannot score
+        them.  An entity absent from training must be unknown to the model,
+        not a zero-factor row (reference behavior: maps are built from the
+        already-filtered ratings).
+        """
+        if self.item_map is None:
+            raise ValueError("drop_items requires an item_map")
+        n = len(self.item_map)
+        keep_item = np.ones(n, bool)
+        idx = np.asarray(item_indices, dtype=np.int64)
+        keep_item[idx[(idx >= 0) & (idx < n)]] = False
+        if keep_item.all():
+            return self
+        row_keep = keep_item[self.item]
+
+        def _compact(mask: np.ndarray, bimap: BiMap):
+            new_of_old = np.cumsum(mask) - 1
+            inv = bimap.inverse
+            new_map = BiMap(
+                {inv[o]: int(new_of_old[o]) for o in range(len(mask)) if mask[o]}
+            )
+            return new_of_old, new_map
+
+        item_of_old, new_item_map = _compact(keep_item, self.item_map)
+        keep_user = np.zeros(len(self.user_map), bool)
+        keep_user[self.user[row_keep]] = True
+        user_of_old, new_user_map = _compact(keep_user, self.user_map)
+        return Interactions(
+            user=user_of_old[self.user[row_keep]].astype(self.user.dtype),
+            item=item_of_old[self.item[row_keep]].astype(self.item.dtype),
+            rating=self.rating[row_keep],
+            t=self.t[row_keep],
+            user_map=new_user_map,
+            item_map=new_item_map,
+        )

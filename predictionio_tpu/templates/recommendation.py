@@ -12,6 +12,13 @@ variants folded into one template):
 * Query supports ``num``, per-query ``blackList`` (blacklist-items variant)
   and optional ``whiteList``; unknown users yield empty results like the
   reference's None branch.
+* Variant switches (reference builds a separate engine per variant; here
+  they are engine.json config):
+  - ``eventRatings`` datasource param — reading-custom-events
+    (``like``→4.0/``dislike``→1.0) and train-with-view-event
+    (``{"view": 1.0}`` + ``implicitPrefs``).
+  - :class:`ExcludeItemsPreparator` ``filepath`` — customize-data-prep.
+  - :class:`FileFilterServing` ``filepath`` — customize-serving.
 """
 
 from __future__ import annotations
@@ -27,9 +34,9 @@ from predictionio_tpu.core import (
     DataSource,
     Engine,
     EngineFactory,
-    IdentityPreparator,
-    FirstServing,
     Params,
+    Preparator,
+    Serving,
 )
 from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.core.evaluation import EngineParamsGenerator, Evaluation
@@ -87,6 +94,11 @@ class DataSourceParams(Params):
     # SelfCleaningDataSource hook: {"duration": "30 days",
     #   "removeDuplicates": true, "compressProperties": true}
     eventWindow: Optional[dict] = None
+    # Map event name → fixed rating value, replacing the default rate+buy
+    # read.  Covers the reading-custom-events variant
+    # (DataSource.scala:50-61: like→4.0, dislike→1.0) and
+    # train-with-view-event ({"view": 1.0} with implicitPrefs on the algo).
+    eventRatings: Optional[dict] = None
 
 
 class RecommendationDataSource(SelfCleaningDataSource, DataSource):
@@ -98,6 +110,20 @@ class RecommendationDataSource(SelfCleaningDataSource, DataSource):
         # one columnar read per event type (fast path on parquet), merged
         # with shared id maps; buys weigh BUY_WEIGHT like the reference
         parts = []
+        if self.params.eventRatings:
+            for name, value in self.params.eventRatings.items():
+                part = PEventStore.find_interactions(
+                    self.params.appName,
+                    entity_type="user",
+                    event_names=[name],
+                    target_entity_type="item",
+                    default_rating=float(value),
+                )
+                if len(part):
+                    parts.append(part)
+            if not parts:
+                return part  # empty Interactions with empty maps
+            return merge_interactions(parts)
         rate = PEventStore.find_interactions(
             self.params.appName,
             entity_type="user",
@@ -138,16 +164,7 @@ class RecommendationDataSource(SelfCleaningDataSource, DataSource):
         for f in range(k_fold):
             train_sel = fold_of != f
             test_sel = ~train_sel
-            td = TrainingData(
-                Interactions(
-                    user=inter.user[train_sel],
-                    item=inter.item[train_sel],
-                    rating=inter.rating[train_sel],
-                    t=inter.t[train_sel],
-                    user_map=inter.user_map,
-                    item_map=inter.item_map,
-                )
-            )
+            td = TrainingData(inter.subset(train_sel))
             # group held-out items per user in one sorted pass (O(m log m))
             tu, ti = inter.user[test_sel], inter.item[test_sel]
             order = np.argsort(tu, kind="stable")
@@ -166,6 +183,68 @@ class RecommendationDataSource(SelfCleaningDataSource, DataSource):
                     )
             folds.append((td, qa))
         return folds
+
+
+# -- Preparator (customize-data-prep variant) -------------------------------
+
+
+@dataclasses.dataclass
+class PreparatorParams(Params):
+    # file of item ids (one per line) to drop from training; None → identity
+    # (parity: customize-data-prep Preparator.scala:38-44)
+    filepath: Optional[str] = None
+
+
+class ExcludeItemsPreparator(Preparator):
+    """Drop file-listed items from training data before the algorithm.
+
+    With ``filepath=None`` this is ``IdentityPreparator`` — the variant is a
+    config switch, not a separate engine build.
+    """
+
+    params_cls = PreparatorParams
+
+    def prepare(self, ctx, td: TrainingData) -> TrainingData:
+        # getattr: a caller-constructed EngineParams may carry EmptyParams
+        path = getattr(self.params, "filepath", None)
+        if not path:
+            return td
+        with open(path) as f:
+            no_train = {line.strip() for line in f if line.strip()}
+        if not no_train:
+            return td
+        inter = td.interactions
+        drop_idx = inter.item_map.to_index_array(sorted(no_train))
+        # drop_items compacts the item id space: a filtered item must be
+        # unrecommendable, not a zero-factor candidate still in the map
+        return TrainingData(inter.drop_items(drop_idx[drop_idx >= 0]))
+
+
+# -- Serving (customize-serving variant) ------------------------------------
+
+
+@dataclasses.dataclass
+class ServingParams(Params):
+    # file of disabled item ids, re-read per query so ops can flip products
+    # off without redeploying (parity: customize-serving Serving.scala:33-42)
+    filepath: Optional[str] = None
+
+
+class FileFilterServing(Serving):
+    """FirstServing plus a per-query disabled-items file filter."""
+
+    params_cls = ServingParams
+
+    def serve(self, query: Query, predictions) -> PredictedResult:
+        result = predictions[0]
+        path = getattr(self.params, "filepath", None)
+        if not path:
+            return result
+        with open(path) as f:
+            disabled = {line.strip() for line in f if line.strip()}
+        return PredictedResult(
+            itemScores=[s for s in result.itemScores if s.item not in disabled]
+        )
 
 
 # -- Algorithm --------------------------------------------------------------
@@ -383,9 +462,9 @@ class RecommendationEngine(EngineFactory):
     def apply(cls) -> Engine:
         return Engine(
             data_source_cls=RecommendationDataSource,
-            preparator_cls=IdentityPreparator,
+            preparator_cls=ExcludeItemsPreparator,
             algorithm_cls_map={"als": ALSAlgorithm},
-            serving_cls=FirstServing,
+            serving_cls=FileFilterServing,
             query_cls=Query,
         )
 

@@ -265,6 +265,135 @@ def test_batch_predict_matches_per_query(app_with_events):
         assert got == want, f"query {i} diverged"
 
 
+def test_event_ratings_variant(app_with_events):
+    """reading-custom-events parity: like→4.0 / dislike→1.0 via config."""
+    storage = app_with_events
+    app_id = storage.get_meta_data_apps().get_by_name("testapp").id
+    le = storage.get_l_events()
+    for u, i, ev in [("u1", "i3", "like"), ("u2", "i9", "dislike")]:
+        le.insert(
+            Event(
+                event=ev, entity_type="user", entity_id=u,
+                target_entity_type="item", target_entity_id=i,
+            ),
+            app_id,
+        )
+    from predictionio_tpu.templates.recommendation import (
+        DataSourceParams,
+        RecommendationDataSource,
+    )
+
+    ds = RecommendationDataSource(
+        DataSourceParams(
+            appName="testapp", eventRatings={"like": 4.0, "dislike": 1.0}
+        )
+    )
+    inter = ds._read_interactions()
+    # only the two custom events are read — rate/buy are ignored
+    assert len(inter) == 2
+    by_pair = {
+        (inter.user_map.inverse[int(u)], inter.item_map.inverse[int(i)]): r
+        for u, i, r in zip(inter.user, inter.item, inter.rating)
+    }
+    assert by_pair == {("u1", "i3"): 4.0, ("u2", "i9"): 1.0}
+
+
+def test_exclude_items_preparator(app_with_events, tmp_path):
+    """customize-data-prep parity: file-listed items dropped before train."""
+    from predictionio_tpu.templates.recommendation import (
+        ExcludeItemsPreparator,
+        PreparatorParams,
+        RecommendationDataSource,
+        DataSourceParams,
+    )
+
+    ds = RecommendationDataSource(DataSourceParams(appName="testapp"))
+    ctx = MeshContext.create()
+    td = ds.read_training(ctx)
+    assert {
+        td.interactions.item_map.inverse[int(i)] for i in td.interactions.item
+    } & {"i0", "i1"}
+    path = tmp_path / "no_train.txt"
+    path.write_text("i0\ni1\nnot-an-item\n")
+    prep = ExcludeItemsPreparator(PreparatorParams(filepath=str(path)))
+    pd = prep.prepare(ctx, td)
+    kept = {
+        pd.interactions.item_map.inverse[int(i)] for i in pd.interactions.item
+    }
+    assert not kept & {"i0", "i1"}
+    assert len(pd.interactions) < len(td.interactions)
+    # the excluded items leave the model's id space entirely — they must be
+    # unrecommendable, not zero-factor candidates (reference: filtered items
+    # never enter MLlib productFeatures)
+    assert "i0" not in pd.interactions.item_map
+    assert "i1" not in pd.interactions.item_map
+    assert len(pd.interactions.item_map) == len(td.interactions.item_map) - 2
+    # indices are compacted and consistent with the new map
+    inv = pd.interactions.item_map.inverse
+    assert {int(i) for i in pd.interactions.item} <= set(
+        range(len(pd.interactions.item_map))
+    )
+    assert all(
+        inv[int(i)] not in {"i0", "i1"} for i in pd.interactions.item
+    )
+    # no filepath → identity
+    identity = ExcludeItemsPreparator(PreparatorParams()).prepare(ctx, td)
+    assert identity is td
+
+
+def test_drop_items_compacts_orphaned_users():
+    """A user whose every interaction involved dropped items becomes unknown
+    to the model (reference: maps built from already-filtered ratings)."""
+    from predictionio_tpu.data.batch import Interactions
+    from predictionio_tpu.data.bimap import BiMap
+
+    inter = Interactions(
+        user=np.array([0, 1, 1], np.int32),
+        item=np.array([0, 0, 1], np.int32),
+        rating=np.ones(3, np.float32),
+        t=np.zeros(3),
+        user_map=BiMap({"only-i0": 0, "both": 1}),
+        item_map=BiMap({"i0": 0, "i1": 1}),
+    )
+    out = inter.drop_items(np.array([0]))
+    assert "i0" not in out.item_map and "only-i0" not in out.user_map
+    assert list(out.user_map) == ["both"] and list(out.item_map) == ["i1"]
+    assert out.user.tolist() == [0] and out.item.tolist() == [0]
+    # no-op drop returns self
+    assert inter.drop_items(np.array([], np.int64)) is inter
+
+
+def test_file_filter_serving_end_to_end(app_with_events, tmp_path):
+    """customize-serving parity: disabled-items file filters at serve time,
+    re-read per query so flipping the file needs no redeploy."""
+    import copy
+
+    storage = app_with_events
+    engine = RecommendationEngine.apply()
+    disabled = tmp_path / "disabled.txt"
+    disabled.write_text("")
+    variant = copy.deepcopy(VARIANT)
+    variant["serving"] = {"params": {"filepath": str(disabled)}}
+    ep = engine.params_from_variant(variant)
+    ctx = MeshContext.create()
+    run_train(engine, ep, VARIANT["engineFactory"], storage=storage, ctx=ctx)
+    inst = get_latest_completed_instance(storage)
+    _, algorithms, serving, models = prepare_deploy(
+        engine, inst, storage=storage, ctx=ctx
+    )
+
+    def query(q):
+        qq = serving.supplement(q)
+        return serving.serve(qq, [algorithms[0].predict(models[0], qq)])
+
+    before = query(Query(user="u1", num=4)).itemScores
+    assert len(before) == 4
+    # ops flips two products off — same deployment, next query honors it
+    disabled.write_text("\n".join([before[0].item, before[1].item]))
+    after = query(Query(user="u1", num=4)).itemScores
+    assert {s.item for s in after}.isdisjoint({before[0].item, before[1].item})
+
+
 def test_eval_read_folds(app_with_events):
     engine = RecommendationEngine.apply()
     variant = dict(VARIANT)
