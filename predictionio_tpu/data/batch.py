@@ -378,6 +378,15 @@ class Interactions:
             return new_of_old, new_map
 
         item_of_old, new_item_map = _compact(keep_item, self.item_map)
+        if self.user_map is None:
+            return Interactions(
+                user=self.user[row_keep],
+                item=item_of_old[self.item[row_keep]].astype(self.item.dtype),
+                rating=self.rating[row_keep],
+                t=self.t[row_keep],
+                user_map=None,
+                item_map=new_item_map,
+            )
         keep_user = np.zeros(len(self.user_map), bool)
         keep_user[self.user[row_keep]] = True
         user_of_old, new_user_map = _compact(keep_user, self.user_map)

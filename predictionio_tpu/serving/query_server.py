@@ -57,7 +57,15 @@ class EngineServerPlugin:
 
 def _to_jsonable(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        return dataclasses.asdict(obj)
+        # None-valued fields are omitted, matching the reference's json4s
+        # treatment of Option None (absent field, not null)
+        return {
+            k: _to_jsonable(v)
+            for k, v in (
+                (f.name, getattr(obj, f.name)) for f in dataclasses.fields(obj)
+            )
+            if v is not None
+        }
     if isinstance(obj, (list, tuple)):
         return [_to_jsonable(o) for o in obj]
     if isinstance(obj, dict):

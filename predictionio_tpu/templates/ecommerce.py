@@ -11,8 +11,12 @@ Capability parity with ``examples/scala-parallel-ecommercerecommendation/``
   **live** ``LEventStore.findByEntity`` read of the user's seen events
   (``:332-360``), and the "unavailableItems" constraint entity read live per
   query (the reference caches it the same way per request).
-* adjust-score variant: optional ``freshness``-style boost hook via
-  ``boostCategories``.
+* adjust-score variant: ``weightedItems`` groups
+  (``adjust-score/ECommAlgorithm.scala:57-60,259-281`` WeightGroup —
+  per-item multipliers applied before ranking), plus a category-level
+  ``boostCategories`` hook.
+* train-with-rate-event variant: ``ratingKey`` datasource param reads
+  graded events as the implicit-confidence weight.
 """
 
 from __future__ import annotations
@@ -77,6 +81,10 @@ PreparedData = TrainingData
 class ECommDataSourceParams(Params):
     appName: str = "default"
     eventNames: tuple = ("view", "buy")
+    # train-with-rate-event variant: read this property as the interaction
+    # weight (e.g. eventNames=["rate"], ratingKey="rating"), so graded
+    # events feed the implicit-ALS confidence instead of weight-1 views
+    ratingKey: Optional[str] = None
 
 
 class ECommDataSource(DataSource):
@@ -88,6 +96,7 @@ class ECommDataSource(DataSource):
             entity_type="user",
             event_names=list(self.params.eventNames),
             target_entity_type="item",
+            rating_key=self.params.ratingKey,
         )
         props = PEventStore.aggregate_properties(self.params.appName, "item")
         item_categories = {
@@ -108,6 +117,10 @@ class ECommAlgorithmParams(Params):
     alpha: float = 1.0
     seed: Optional[int] = None
     boostCategories: Optional[dict] = None  # category → multiplier
+    # adjust-score variant (ECommAlgorithm.scala WeightGroup): groups of
+    # item ids with a weight multiplied into their scores before ranking,
+    # e.g. [{"items": ["i1", "i2"], "weight": 2.0}]
+    weightedItems: Optional[list] = None
 
     json_aliases = {"lambda": "reg"}
 
@@ -186,7 +199,9 @@ class ECommAlgorithm(Algorithm):
             logger.info("user %s unknown; serving popular items", query.user)
             scores = model.popular.copy()
 
-        # boosts rescale BEFORE ranking (adjust-score variant semantics)
+        # boosts/weights rescale BEFORE ranking (adjust-score semantics:
+        # ECommAlgorithm.scala:259-281 multiplies the dot product by the
+        # item's weight group before topN)
         boosts = self.params.boostCategories or {}
         if boosts:
             scores = scores.copy()
@@ -195,6 +210,13 @@ class ECommAlgorithm(Algorithm):
                 for c in model.item_categories.get(inv_all[idx], ()):
                     if c in boosts:
                         scores[idx] *= float(boosts[c])
+        if self.params.weightedItems:
+            weights = np.ones(len(scores), np.float32)
+            for group in self.params.weightedItems:
+                w = float(group.get("weight", 1.0))
+                idx = item_map.to_index_array(list(group.get("items") or []))
+                weights[idx[idx >= 0]] = w
+            scores = scores * weights
 
         excluded: set = set()
         if query.blackList:

@@ -240,8 +240,14 @@ class FileFilterServing(Serving):
         path = getattr(self.params, "filepath", None)
         if not path:
             return result
-        with open(path) as f:
-            disabled = {line.strip() for line in f if line.strip()}
+        try:
+            with open(path) as f:
+                disabled = {line.strip() for line in f if line.strip()}
+        except OSError:
+            # ops edits this file on a live deployment; a briefly-missing
+            # file must degrade to unfiltered serving, not error every query
+            logger.exception("disabled-items file unreadable; serving unfiltered")
+            return result
         return PredictedResult(
             itemScores=[s for s in result.itemScores if s.item not in disabled]
         )

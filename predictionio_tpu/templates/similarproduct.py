@@ -57,6 +57,11 @@ class Query:
 class ItemScore:
     item: str
     score: float
+    # populated when the algorithm's returnProperties param is set — the
+    # item's aggregated $set properties travel with the score
+    # (return-item-properties variant: ALSAlgorithm.scala:192-196 returns
+    # title/date/categories; here the full property map is returned)
+    properties: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -68,6 +73,7 @@ class PredictedResult:
 class TrainingData(SanityCheck):
     interactions: Interactions
     item_categories: dict  # item id → set of category strings
+    item_properties: dict = dataclasses.field(default_factory=dict)
 
     def sanity_check(self):
         if len(self.interactions) == 0:
@@ -100,8 +106,25 @@ class SimilarProductDataSource(DataSource):
             item_id: set(pm.get("categories") or [])
             for item_id, pm in props.items()
         }
-        return TrainingData(interactions=inter, item_categories=item_categories)
+        return TrainingData(
+            interactions=inter,
+            item_categories=item_categories,
+            # plain dicts: these travel into ItemScore.properties and out
+            # through the query server's JSON encoder
+            item_properties={
+                item_id: pm.to_dict() for item_id, pm in props.items()
+            },
+        )
 
+
+
+def _make_item_score(
+    item_properties: dict, return_props: bool, item_id: str, score: float
+) -> ItemScore:
+    """One policy for attaching properties to scores (return-item-properties)."""
+    if not return_props:
+        return ItemScore(item_id, score)
+    return ItemScore(item_id, score, properties=item_properties.get(item_id) or {})
 
 
 def _apply_filters(
@@ -149,6 +172,9 @@ class SimilarALSParams(Params):
     reg: float = 0.01
     alpha: float = 1.0
     seed: Optional[int] = None
+    # return-item-properties variant: attach each item's aggregated $set
+    # properties to its ItemScore
+    returnProperties: bool = False
 
     json_aliases = {"lambda": "reg"}
 
@@ -158,6 +184,7 @@ class SimilarALSModel:
     als: ALSModel
     norm_factors: np.ndarray  # L2-normalized item factors
     item_categories: dict
+    item_properties: dict = dataclasses.field(default_factory=dict)
 
 
 class SimilarALSAlgorithm(Algorithm):
@@ -180,7 +207,15 @@ class SimilarALSAlgorithm(Algorithm):
         norms = np.linalg.norm(als.item_factors, axis=1, keepdims=True)
         norm_factors = als.item_factors / np.maximum(norms, 1e-9)
         return SimilarALSModel(
-            als=als, norm_factors=norm_factors, item_categories=pd.item_categories
+            als=als,
+            norm_factors=norm_factors,
+            item_categories=pd.item_categories,
+            item_properties=pd.item_properties if self.params.returnProperties else {},
+        )
+
+    def _item_score(self, model, item_id: str, score: float) -> ItemScore:
+        return _make_item_score(
+            model.item_properties, self.params.returnProperties, item_id, score
         )
 
     def batch_predict(self, model: SimilarALSModel, queries):
@@ -210,7 +245,7 @@ class SimilarALSAlgorithm(Algorithm):
                 inv = model.als.item_map.inverse
                 by_index[i] = PredictedResult(
                     itemScores=[
-                        ItemScore(inv[int(j)], float(s[j]))
+                        self._item_score(model, inv[int(j)], float(s[j]))
                         for j in top
                         if np.isfinite(s[j])
                     ]
@@ -257,7 +292,7 @@ class SimilarALSAlgorithm(Algorithm):
         inv = item_map.inverse
         return PredictedResult(
             itemScores=[
-                ItemScore(inv[int(i)], float(sims[i]))
+                self._item_score(model, inv[int(i)], float(sims[i]))
                 for i in top
                 if np.isfinite(sims[i])
             ]
@@ -268,12 +303,14 @@ class SimilarALSAlgorithm(Algorithm):
 class CooccurrenceParams(Params):
     n: int = 20  # top-N co-occurring items kept per item
     llr: bool = False  # LLR rescoring (CCO / Universal Recommender mode)
+    returnProperties: bool = False  # return-item-properties variant
 
 
 @dataclasses.dataclass
 class SimilarCooccurrenceModel:
     cooccurrence: CooccurrenceModel
     item_categories: dict
+    item_properties: dict = dataclasses.field(default_factory=dict)
 
 
 class SimilarCooccurrenceAlgorithm(Algorithm):
@@ -284,7 +321,9 @@ class SimilarCooccurrenceAlgorithm(Algorithm):
             ctx, pd.interactions, n=self.params.n, use_llr=self.params.llr
         )
         return SimilarCooccurrenceModel(
-            cooccurrence=model, item_categories=pd.item_categories
+            cooccurrence=model,
+            item_categories=pd.item_categories,
+            item_properties=pd.item_properties if self.params.returnProperties else {},
         )
 
     def predict(self, model: SimilarCooccurrenceModel, query: Query) -> PredictedResult:
@@ -300,7 +339,17 @@ class SimilarCooccurrenceAlgorithm(Algorithm):
         scores = _apply_filters(co.item_map, model.item_categories, query, scores)
         top = sorted(scores.items(), key=lambda kv: -kv[1])[: query.num]
         inv = co.item_map.inverse
-        return PredictedResult(itemScores=[ItemScore(inv[i], s) for i, s in top])
+        return PredictedResult(
+            itemScores=[
+                _make_item_score(
+                    model.item_properties,
+                    self.params.returnProperties,
+                    inv[i],
+                    s,
+                )
+                for i, s in top
+            ]
+        )
 
 
 class SumServing(Serving):
@@ -311,12 +360,18 @@ class SumServing(Serving):
 
     def serve(self, query: Query, predictions: Sequence[PredictedResult]):
         combined: dict[str, float] = defaultdict(float)
+        props: dict[str, dict] = {}
         for pred in predictions:
             for s in pred.itemScores:
                 combined[s.item] += s.score
+                if s.properties is not None:
+                    props.setdefault(s.item, s.properties)
         top = sorted(combined.items(), key=lambda kv: -kv[1])[: query.num]
         return PredictedResult(
-            itemScores=[ItemScore(item, score) for item, score in top]
+            itemScores=[
+                ItemScore(item, score, properties=props.get(item))
+                for item, score in top
+            ]
         )
 
 

@@ -93,6 +93,7 @@ BUILTIN_TEMPLATES = {
     "recommendation": "predictionio_tpu.templates.recommendation.RecommendationEngine",
     "classification": "predictionio_tpu.templates.classification.ClassificationEngine",
     "similarproduct": "predictionio_tpu.templates.similarproduct.SimilarProductEngine",
+    "similaruser": "predictionio_tpu.templates.similaruser.SimilarUserEngine",
     "ecommercerecommendation": "predictionio_tpu.templates.ecommerce.ECommerceEngine",
     "sequentialrecommendation": (
         "predictionio_tpu.templates.sequentialrecommendation."

@@ -97,6 +97,55 @@ class TestClassificationTemplate:
         finally:
             del cls_mod.AppEval
 
+    def test_reading_custom_properties(self, app, ctx):
+        """reading-custom-properties parity: entityType, feature attributes
+        and label attribute are all config, with required-property filtering."""
+        from predictionio_tpu.templates.classification import (
+            ClassificationEngine,
+            Query,
+        )
+
+        rng = np.random.default_rng(2)
+        for i in range(80):
+            a, b = rng.uniform(0, 10, 2)
+            # label by proportion (a>b), the signal a multinomial NB sees
+            app["le"].insert(
+                Event(
+                    event="$set", entity_type="item", entity_id=f"it{i}",
+                    properties={
+                        "featureA": a, "featureB": b,
+                        "grade": "good" if a > b else "bad",
+                    },
+                ),
+                app["app_id"],
+            )
+        # one entity missing required properties is filtered, not fatal
+        app["le"].insert(
+            Event(
+                event="$set", entity_type="item", entity_id="partial",
+                properties={"featureA": 1.0},
+            ),
+            app["app_id"],
+        )
+        engine = ClassificationEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {
+                    "params": {
+                        "appName": "tapp",
+                        "entityType": "item",
+                        "attributes": ["featureA", "featureB"],
+                        "labelAttribute": "grade",
+                    }
+                },
+                "algorithms": [{"name": "naive"}],
+            }
+        )
+        model = engine.train(ctx, ep)[0]
+        algo = engine.make_algorithms(ep)[0]
+        assert algo.predict(model, Query(features=[9.0, 1.0])).label == "good"
+        assert algo.predict(model, Query(features=[1.0, 9.0])).label == "bad"
+
     def test_evaluation_accuracy(self, app, ctx):
         from predictionio_tpu.templates.classification import (
             Accuracy,
@@ -240,6 +289,186 @@ class TestSimilarProductTemplate:
         algo = engine.make_algorithms(ep)[0]
         res = algo.predict(models[0], Query(items=["i0"], num=3))
         assert res.itemScores and all(s.score > 0 for s in res.itemScores)
+
+    def test_rate_event_training(self, app, ctx):
+        """train-with-rate-event parity: ratingKey reads graded views."""
+        from predictionio_tpu.templates.similarproduct import (
+            SimilarProductDataSource,
+            DataSourceParams,
+        )
+
+        rng = np.random.default_rng(4)
+        for u in range(10):
+            for i in rng.choice(10, size=3, replace=False):
+                app["le"].insert(
+                    Event(
+                        event="rate", entity_type="user", entity_id=f"u{u}",
+                        target_entity_type="item", target_entity_id=f"i{i}",
+                        properties={"rating": float(rng.integers(1, 6))},
+                    ),
+                    app["app_id"],
+                )
+        ds = SimilarProductDataSource(
+            DataSourceParams(
+                appName="tapp", eventNames=("rate",), ratingKey="rating"
+            )
+        )
+        td = ds.read_training(MeshContext.create())
+        assert len(td.interactions) == 30
+        assert td.interactions.rating.min() >= 1.0
+        assert td.interactions.rating.max() <= 5.0
+        assert len(np.unique(td.interactions.rating)) > 1  # graded, not 1.0
+
+    def test_return_item_properties(self, app, ctx):
+        """return-item-properties parity: scores carry aggregated $set
+        properties through both algorithms and the serving merge."""
+        from predictionio_tpu.templates.similarproduct import (
+            Query,
+            SimilarProductEngine,
+            SumServing,
+        )
+
+        self.seed_views(app["le"], app["app_id"])
+        # richer properties than just categories (title/date in the reference)
+        app["le"].insert(
+            Event(
+                event="$set", entity_type="item", entity_id="i1",
+                properties={"title": "The Item", "date": "2001-01-01"},
+            ),
+            app["app_id"],
+        )
+        engine = SimilarProductEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {
+                            "rank": 6, "numIterations": 4,
+                            "returnProperties": True,
+                        },
+                    },
+                    {
+                        "name": "cooccurrence",
+                        "params": {"n": 5, "returnProperties": True},
+                    },
+                ],
+            }
+        )
+        models = engine.train(ctx, ep)
+        algos = engine.make_algorithms(ep)
+        q = Query(items=["i0"], num=5)
+        preds = [a.predict(m, q) for a, m in zip(algos, models)]
+        for pred in preds:
+            for s in pred.itemScores:
+                assert s.properties is not None
+        merged = SumServing().serve(q, preds)
+        by_item = {s.item: s for s in merged.itemScores}
+        assert "i1" in by_item  # co-viewed with i0 in the even/odd groups
+        assert by_item["i1"].properties["title"] == "The Item"
+        assert by_item["i1"].properties["date"] == "2001-01-01"
+        assert "categories" in by_item["i1"].properties
+
+        # default (returnProperties off) keeps the wire format clean
+        ep_off = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {"name": "als", "params": {"rank": 6, "numIterations": 4}}
+                ],
+            }
+        )
+        models_off = engine.train(ctx, ep_off)
+        pred_off = engine.make_algorithms(ep_off)[0].predict(models_off[0], q)
+        assert all(s.properties is None for s in pred_off.itemScores)
+        from predictionio_tpu.serving.query_server import _to_jsonable
+
+        js = _to_jsonable(pred_off)
+        assert all("properties" not in s for s in js["itemScores"])
+
+
+class TestSimilarUserTemplate:
+    def seed_follows(self, le, app_id):
+        # two communities: f0..f4 followed by u0..u19, f5..f9 by u20..u39
+        rng = np.random.default_rng(11)
+        for u in range(40):
+            followed = range(0, 5) if u < 20 else range(5, 10)
+            for f in rng.choice(list(followed), size=4, replace=False):
+                le.insert(
+                    Event(
+                        event="follow",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="user",
+                        target_entity_id=f"f{f}",
+                    ),
+                    app_id,
+                )
+
+    def make(self, ctx):
+        from predictionio_tpu.templates.similaruser import SimilarUserEngine
+
+        engine = SimilarUserEngine.apply()
+        # low rank on purpose: the 2-community follow graph separates into
+        # the top factors; near-full rank overfits and blurs the cosines
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {
+                            "rank": 2, "numIterations": 15, "alpha": 10.0
+                        },
+                    }
+                ],
+            }
+        )
+        models = engine.train(ctx, ep)
+        return engine.make_algorithms(ep)[0], models[0]
+
+    def test_recommends_community_cofollowed(self, app, ctx):
+        """recommended-user parity: follow events → similar followed users."""
+        from predictionio_tpu.templates.similaruser import Query
+
+        self.seed_follows(app["le"], app["app_id"])
+        algo, model = self.make(ctx)
+        res = algo.predict(model, Query(users=["f0"], num=3))
+        got = [s.user for s in res.similarUserScores]
+        assert got, "no similar users returned"
+        assert "f0" not in got  # query users are excluded
+        # community structure: f0's neighbors are f1..f4, not f5..f9
+        assert all(u in {"f1", "f2", "f3", "f4"} for u in got)
+        scores = [s.score for s in res.similarUserScores]
+        assert scores == sorted(scores, reverse=True)
+        assert all(s > 0 for s in scores)  # reference keeps positive only
+
+    def test_white_black_lists_and_unknown(self, app, ctx):
+        from predictionio_tpu.templates.similaruser import Query
+
+        self.seed_follows(app["le"], app["app_id"])
+        algo, model = self.make(ctx)
+        res = algo.predict(
+            model, Query(users=["f0"], num=5, blackList=["f1"])
+        )
+        assert "f1" not in {s.user for s in res.similarUserScores}
+        res_w = algo.predict(
+            model, Query(users=["f0"], num=5, whiteList=["f2", "f3"])
+        )
+        assert {s.user for s in res_w.similarUserScores} <= {"f2", "f3"}
+        # entirely unknown query users → empty, not an error
+        assert (
+            algo.predict(model, Query(users=["nobody"], num=3)).similarUserScores
+            == []
+        )
+
+    def test_cli_template_registered(self):
+        from predictionio_tpu.tools.cli import BUILTIN_TEMPLATES
+        from predictionio_tpu.core.persistence import resolve_class
+
+        cls = resolve_class(BUILTIN_TEMPLATES["similaruser"])
+        assert cls.apply().query_cls is not None
 
 
 class TestSequentialTemplate:
@@ -409,3 +638,80 @@ class TestECommerceTemplate:
         )
         res3 = algo.predict(model, Query(user="u0", num=3))
         assert block in {s.item for s in res3.itemScores}
+
+    def test_weighted_items_adjust_score(self, app, ctx):
+        """adjust-score parity: WeightGroup multipliers reorder the ranking."""
+        from predictionio_tpu.templates.ecommerce import ECommerceEngine, Query
+
+        self.seed(app["le"], app["app_id"])
+        algo, model = self.make(ctx)
+        base = algo.predict(model, Query(user="u0", num=6))
+        loser = base.itemScores[-1].item  # weakest of u0's top-6
+
+        engine = ECommerceEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {
+                        "name": "ecomm",
+                        "params": {
+                            "appName": "tapp", "rank": 6, "numIterations": 6,
+                            "weightedItems": [
+                                {"items": [loser], "weight": 1000.0}
+                            ],
+                        },
+                    }
+                ],
+            }
+        )
+        wmodel = engine.train(ctx, ep)[0]
+        walgo = engine.make_algorithms(ep)[0]
+        res = walgo.predict(wmodel, Query(user="u0", num=6))
+        assert res.itemScores[0].item == loser  # boosted to the top
+
+    def test_rate_event_training(self, app, ctx):
+        """train-with-rate-event parity: graded events as implicit weight."""
+        from predictionio_tpu.templates.ecommerce import ECommerceEngine, Query
+
+        rng = np.random.default_rng(3)
+        for u in range(20):
+            items = range(0, 5) if u % 2 == 0 else range(5, 10)
+            for i in rng.choice(list(items), size=3, replace=False):
+                app["le"].insert(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties={"rating": float(rng.integers(1, 6))},
+                    ),
+                    app["app_id"],
+                )
+        engine = ECommerceEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {
+                    "params": {
+                        "appName": "tapp",
+                        "eventNames": ["rate"],
+                        "ratingKey": "rating",
+                    }
+                },
+                "algorithms": [
+                    {
+                        "name": "ecomm",
+                        "params": {"appName": "tapp", "rank": 6,
+                                   "numIterations": 6},
+                    }
+                ],
+            }
+        )
+        model = engine.train(ctx, ep)[0]
+        algo = engine.make_algorithms(ep)[0]
+        res = algo.predict(model, Query(user="u0", num=4))
+        assert len(res.itemScores) == 4
+        # even-user community structure learned from graded events
+        hits = sum(1 for s in res.itemScores if int(s.item[1:]) < 5)
+        assert hits >= 3
