@@ -34,6 +34,29 @@ logger = logging.getLogger(__name__)
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
+_platform_pinned = False
+
+
+def pin_platform_from_env() -> None:
+    """Make ``JAX_PLATFORMS`` from the environment stick, config-level.
+
+    Some deployment images register extra PJRT backends at interpreter
+    start and re-append them to ``jax_platforms`` even when the env var
+    names only ``cpu`` — and an unreachable accelerator backend then hangs
+    the first device query indefinitely. Pinning the env value into
+    ``jax.config`` (what tests/conftest.py does) restores the documented
+    env-var semantics. No-op when JAX_PLATFORMS is unset.
+    """
+    global _platform_pinned
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and not _platform_pinned:
+        jax.config.update("jax_platforms", plat)
+        # latch only after an actual pin, so setting the env var later
+        # still takes effect on the next call
+        _platform_pinned = True
+
 
 def pad_to_multiple(n: int, m: int) -> int:
     """Smallest multiple of m that is >= max(n, 1) — static-shape padding."""
@@ -93,6 +116,7 @@ class MeshContext:
         axes: Optional[Mapping[str, int]] = None,
         devices: Optional[Sequence[jax.Device]] = None,
     ) -> "MeshContext":
+        pin_platform_from_env()
         conf = dict(conf or {})
         if axes is None and "mesh_axes" in conf:
             axes = {k: int(v) for k, v in conf["mesh_axes"].items()}

@@ -369,6 +369,29 @@ def cmd_batchpredict(args) -> int:
     return 0
 
 
+def cmd_shell(args) -> int:
+    """Interactive console with the framework preloaded (parity role:
+    bin/pio-shell's sbt console — here a Python REPL with pypio ready)."""
+    import code
+
+    from predictionio_tpu import pypio
+    from predictionio_tpu.data.store import LEventStore, PEventStore
+
+    ns = {
+        "pypio": pypio,
+        "PEventStore": PEventStore,
+        "LEventStore": LEventStore,
+        "storage": _storage(),
+    }
+    banner = (
+        "predictionio_tpu shell — preloaded: pypio, PEventStore, "
+        "LEventStore, storage (the PIO_STORAGE_* backends).\n"
+        "Start with pypio.init(); try pypio.find_events(app_name=...)."
+    )
+    code.interact(banner=banner, local=ns, exitmsg="")
+    return 0
+
+
 def cmd_eventserver(args) -> int:
     from predictionio_tpu.data.api.event_server import EventServer
 
@@ -650,6 +673,8 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--directory", default=None)
     sp.set_defaults(func=cmd_template)
 
+    sub.add_parser("shell").set_defaults(func=cmd_shell)
+
     sp = sub.add_parser("run")
     sp.add_argument("main")
     sp.add_argument("args", nargs="*")
@@ -676,6 +701,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         level=logging.DEBUG if args.verbose else logging.WARNING,
         format="[%(levelname)s] [%(name)s] %(message)s",
     )
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the operator's platform choice before anything can touch a
+        # device backend — an unreachable accelerator plugin must not hang
+        # CPU-only verbs (see parallel/mesh.pin_platform_from_env)
+        from predictionio_tpu.parallel.mesh import pin_platform_from_env
+
+        pin_platform_from_env()
     try:
         return args.func(args)
     except (FileNotFoundError, ValueError, RuntimeError) as e:

@@ -1,0 +1,111 @@
+"""Operator scripts: pio-start-all / pio-stop-all / pio shell.
+
+Parity model: reference ``bin/pio-start-all``/``pio-stop-all`` (single-node
+service boot with pidfiles) and ``bin/pio-shell`` (console with the
+framework loaded).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+from tests.test_cli_e2e import free_port, wait_alive
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+BIN = REPO / "bin"
+
+
+def _env(tmp_path, extra=None):
+    env = dict(os.environ)
+    env.update(
+        {
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.sqlite"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+            "PIO_PID_DIR": str(tmp_path / "run"),
+        }
+    )
+    env.update(extra or {})
+    return env
+
+
+def test_start_all_stop_all_cycle(tmp_path):
+    es_port, dash_port = free_port(), free_port()
+    env = _env(
+        tmp_path,
+        {
+            "PIO_EVENTSERVER_PORT": str(es_port),
+            "PIO_DASHBOARD_PORT": str(dash_port),
+        },
+    )
+    out = subprocess.run(
+        [str(BIN / "pio-start-all")], env=env, capture_output=True, text=True
+    )
+    assert out.returncode == 0, out.stderr
+    try:
+        pid_dir = tmp_path / "run"
+        assert (pid_dir / "eventserver.pid").exists()
+        assert (pid_dir / "dashboard.pid").exists()
+        # services actually came up and answer HTTP
+        wait_alive(f"http://127.0.0.1:{es_port}/")
+        with urllib.request.urlopen(f"http://127.0.0.1:{es_port}/") as r:
+            assert json.loads(r.read())["status"] == "alive"
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{dash_port}/", timeout=2
+                )
+                break
+            except Exception:
+                time.sleep(0.2)
+        else:
+            raise TimeoutError("dashboard never came alive")
+        # double-start refuses while pidfiles are live
+        again = subprocess.run(
+            [str(BIN / "pio-start-all")], env=env, capture_output=True, text=True
+        )
+        assert again.returncode != 0
+        assert "already running" in again.stderr
+    finally:
+        stop = subprocess.run(
+            [str(BIN / "pio-stop-all")], env=env, capture_output=True, text=True
+        )
+    assert stop.returncode == 0, stop.stderr
+    assert not list((tmp_path / "run").glob("*.pid"))  # pidfiles cleaned up
+    # ports released
+    time.sleep(0.3)
+    with pytest.raises(Exception):
+        urllib.request.urlopen(f"http://127.0.0.1:{es_port}/", timeout=1)
+
+
+def test_stop_all_without_services(tmp_path):
+    env = _env(tmp_path)
+    out = subprocess.run(
+        [str(BIN / "pio-stop-all")], env=env, capture_output=True, text=True
+    )
+    assert out.returncode == 0
+    assert "Nothing to stop" in out.stdout
+
+
+def test_shell_preloads_framework(tmp_path):
+    env = _env(tmp_path)
+    out = subprocess.run(
+        [str(BIN / "pio"), "shell"],
+        input="print('STORAGE_IS', type(storage).__name__)\n"
+        "print('PYPIO_IS', pypio.__name__)\n",
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "STORAGE_IS Storage" in out.stdout
+    assert "PYPIO_IS predictionio_tpu.pypio" in out.stdout
