@@ -7,21 +7,31 @@ high-volume event store, keyed for time-ordered scans
 device-sharded arrays, so events live in Parquet parts per (app, channel):
 
     <path>/app_<id>_ch_<cid>/events-<seq>.parquet   immutable sorted parts
-    <path>/app_<id>_ch_<cid>/wal.jsonl              row-append write-ahead log
+    <path>/app_<id>_ch_<cid>/wal-<writer>.jsonl     per-writer append logs
 
-Writes append to the WAL (cheap, durable); reads merge parts + WAL with
-delete tombstones applied; ``compact()`` folds the WAL into a new part
-(auto-triggered past a threshold).  ``PEvents.find`` materializes the
-:class:`EventBatch` straight from Arrow columns — no per-row Event objects on
-the bulk path.
+Writes append to the calling process's own WAL file (cheap, durable, and
+safe for concurrent writer processes sharing the directory — appends never
+interleave across files); reads merge parts + all WALs with delete
+tombstones applied; ``compact()`` folds the WALs into a new part
+(auto-triggered past a threshold), serialized across processes by an
+``flock`` on ``.parts.lock`` and deleting exactly the files it folded.
+``PEvents.find`` materializes the :class:`EventBatch` straight from Arrow
+columns — no per-row Event objects on the bulk path.
+
+Time-ordered scans (the HBase row-key design's purpose) map to parquet
+row-group statistics: parts are written sorted by ``event_time``, and
+time-ranged reads prune whole part files whose [min, max] event_time lies
+outside the requested window before any bytes are read.
 """
 
 from __future__ import annotations
 
+import contextlib
 import datetime as _dt
 import json
 import os
 import threading
+import uuid
 from typing import Iterable, Optional, Sequence
 
 import numpy as np
@@ -30,6 +40,10 @@ from predictionio_tpu.data.batch import EventBatch, LazyJsonProperties
 from predictionio_tpu.data.event import DataMap, Event, new_event_id
 from predictionio_tpu.data.storage import base
 UTC = _dt.timezone.utc
+
+# one WAL file per writer process: concurrent event servers / importers on a
+# shared filesystem never interleave within a file
+_WRITER_TOKEN = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
 
 
 def _ts(d: _dt.datetime) -> float:
@@ -56,6 +70,8 @@ _SCHEMA_COLS = [
 
 _LOCKS: dict[str, threading.RLock] = {}
 _LOCKS_GUARD = threading.Lock()
+# flock reentrancy depth per namespace dir; guarded by the namespace RLock
+_FLOCK_DEPTH: dict[str, int] = {}
 
 
 def _lock_for(path: str) -> threading.RLock:
@@ -122,13 +138,54 @@ def _row_to_event(r: dict) -> Event:
     )
 
 
+_PART_TIME_RANGES: dict[tuple, tuple[float, float]] = {}
+_PART_TIME_RANGES_MAX = 8192
+
+
+def _part_time_range(path: str) -> Optional[tuple[float, float]]:
+    """[min, max] event_time of a part from parquet metadata (no data read).
+
+    Part FILES are immutable but paths are reused (wipe() restarts the
+    sequence at events-000000), so the cache keys on (path, mtime_ns,
+    size) — a recreated file at the same path never serves the previous
+    generation's statistics. Returns None when statistics are unavailable
+    (never skip what we cannot prove stale).
+    """
+    import pyarrow.parquet as pq
+
+    try:
+        st = os.stat(path)
+        key = (path, st.st_mtime_ns, st.st_size)
+        got = _PART_TIME_RANGES.get(key)
+        if got is not None:
+            return got
+        meta = pq.read_metadata(path)
+        col_idx = meta.schema.names.index("event_time")
+        lo, hi = None, None
+        for rg in range(meta.num_row_groups):
+            stats = meta.row_group(rg).column(col_idx).statistics
+            if stats is None or not stats.has_min_max:
+                return None
+            lo = stats.min if lo is None else min(lo, stats.min)
+            hi = stats.max if hi is None else max(hi, stats.max)
+        if lo is None:
+            return None
+    except Exception:
+        return None
+    if len(_PART_TIME_RANGES) >= _PART_TIME_RANGES_MAX:
+        _PART_TIME_RANGES.clear()  # entries for deleted parts never age out
+    _PART_TIME_RANGES[key] = (float(lo), float(hi))
+    return _PART_TIME_RANGES[key]
+
+
 class _Namespace:
-    """One (app, channel) directory of parts + WAL."""
+    """One (app, channel) directory of parts + per-writer WALs."""
 
     def __init__(self, root: str, app_id: int, channel_id: Optional[int]):
         cid = 0 if channel_id is None else channel_id
         self.dir = os.path.join(root, f"app_{app_id}_ch_{cid}")
-        self.wal_path = os.path.join(self.dir, "wal.jsonl")
+        # this process's own WAL; readers merge every wal*.jsonl in the dir
+        self.wal_path = os.path.join(self.dir, f"wal-{_WRITER_TOKEN}.jsonl")
         self.lock = _lock_for(self.dir)
 
     def ensure(self):
@@ -137,18 +194,72 @@ class _Namespace:
     def exists(self) -> bool:
         return os.path.isdir(self.dir)
 
-    # -- WAL ---------------------------------------------------------------
-    def append_wal(self, ops: Sequence[dict]):
+    @contextlib.contextmanager
+    def parts_lock(self, shared: bool = False):
+        """Cross-process file lock (flock) + the in-process lock.
+
+        The multi-process protocol: anything that rewrites or deletes
+        part/WAL files (compaction, bulk part writes) holds this
+        EXCLUSIVE; appends and reads hold it SHARED. So a compaction in
+        one process can neither fold away a WAL mid-append in another,
+        nor delete part files out from under a reader's listing — the
+        two races a shared (POSIX, coherent-flock) filesystem otherwise
+        allows. Reentrant within a process: the RLock serializes
+        threads, and a depth counter skips the (non-reentrant) flock on
+        nested entry — compact() calling write_part() and read_columns()
+        must not deadlock on its own lock.
+        """
+        import fcntl
+
         self.ensure()
-        with self.lock, open(self.wal_path, "a") as f:
+        with self.lock:
+            depth = _FLOCK_DEPTH.get(self.dir, 0)
+            if depth:
+                # nested under this process's own lock (any mode): the
+                # outer hold already provides the needed exclusion
+                _FLOCK_DEPTH[self.dir] = depth + 1
+                try:
+                    yield
+                finally:
+                    _FLOCK_DEPTH[self.dir] = depth
+                return
+            with open(os.path.join(self.dir, ".parts.lock"), "a") as lf:
+                fcntl.flock(lf, fcntl.LOCK_SH if shared else fcntl.LOCK_EX)
+                _FLOCK_DEPTH[self.dir] = 1
+                try:
+                    yield
+                finally:
+                    _FLOCK_DEPTH[self.dir] = 0
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+
+    # -- WAL ---------------------------------------------------------------
+    def wal_paths(self) -> list[str]:
+        if not self.exists():
+            return []
+        return sorted(
+            os.path.join(self.dir, p)
+            for p in os.listdir(self.dir)
+            if p.startswith("wal") and p.endswith(".jsonl")
+        )
+
+    def append_wal(self, ops: Sequence[dict]):
+        # shared lock: a concurrent compaction (exclusive) cannot snapshot
+        # this WAL file mid-append and then delete rows it never read
+        with self.parts_lock(shared=True), open(self.wal_path, "a") as f:
             for op in ops:
                 f.write(json.dumps(op) + "\n")
 
-    def read_wal(self) -> list[dict]:
-        if not os.path.exists(self.wal_path):
-            return []
-        with self.lock, open(self.wal_path) as f:
-            return [json.loads(line) for line in f if line.strip()]
+    def read_wal(self, paths: Optional[Sequence[str]] = None) -> list[dict]:
+        """Merge WAL files; ops keep per-file order, files in sorted order."""
+        out: list[dict] = []
+        with self.lock:
+            for path in paths if paths is not None else self.wal_paths():
+                try:
+                    with open(path) as f:
+                        out.extend(json.loads(l) for l in f if l.strip())
+                except FileNotFoundError:
+                    continue  # folded away by a concurrent compaction
+        return out
 
     # -- parts -------------------------------------------------------------
     def part_paths(self) -> list[str]:
@@ -160,18 +271,40 @@ class _Namespace:
             if p.startswith("events-") and p.endswith(".parquet")
         )
 
-    def read_columns(self) -> dict[str, np.ndarray]:
+    def read_columns(
+        self,
+        start_ts: Optional[float] = None,
+        until_ts: Optional[float] = None,
+    ) -> dict[str, np.ndarray]:
         """All rows (parts + WAL inserts − deletes) as column arrays.
 
         Arrow columns convert straight to numpy (no Python row lists);
         promoted numeric property columns (``pnum_<key>``) ride along under
         the ``numeric:<key>`` keys with WAL rows filled from their JSON.
+
+        ``start_ts``/``until_ts`` prune whole part files by their
+        event_time statistics before reading a byte — the HBase
+        time-ordered-scan analog. Pruning is file-level only: surviving
+        rows still need the caller's row-level time mask.
         """
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        with self.lock:
-            tables = [pq.read_table(p) for p in self.part_paths()]
+        with self.parts_lock(shared=True):
+            paths = self.part_paths()
+            if start_ts is not None or until_ts is not None:
+                kept = []
+                for p in paths:
+                    rng = _part_time_range(p)
+                    if rng is not None:
+                        lo, hi = rng
+                        if start_ts is not None and hi < start_ts:
+                            continue
+                        if until_ts is not None and lo >= until_ts:
+                            continue
+                    kept.append(p)
+                paths = kept
+            tables = [pq.read_table(p) for p in paths]
             wal = self.read_wal()
         if tables:
             merged = pa.concat_tables(tables, promote_options="default")
@@ -243,10 +376,13 @@ class _Namespace:
         return cols
 
     def wal_bytes(self) -> int:
-        try:
-            return os.path.getsize(self.wal_path)
-        except OSError:
-            return 0
+        total = 0
+        for p in self.wal_paths():
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                continue
+        return total
 
     def _next_seq(self) -> int:
         parts = self.part_paths()
@@ -255,19 +391,24 @@ class _Namespace:
         last = os.path.basename(parts[-1])
         return int(last[len("events-") : -len(".parquet")]) + 1
 
-    def write_part(self, cols: dict[str, np.ndarray], replace_all: bool = False):
+    def write_part(
+        self,
+        cols: dict[str, np.ndarray],
+        replaces: Optional[Sequence[str]] = None,
+    ):
         """Write an immutable sorted part from column arrays.
 
         ``cols`` holds the schema columns plus optional ``numeric:<key>``
-        promoted columns; rows are sorted by event_time. With
-        ``replace_all`` the new part supersedes every existing part + WAL
-        (compaction); otherwise it is appended as a fresh part (bulk write).
+        promoted columns; rows are sorted by event_time. ``replaces`` names
+        exactly the part/WAL files this new part supersedes (compaction
+        deletes only what it folded — files written concurrently by other
+        processes survive); None appends a fresh part (bulk write). Either
+        way the mutation holds the cross-process parts lock.
         """
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        self.ensure()
-        with self.lock:
+        with self.parts_lock():
             order = np.argsort(cols["event_time"], kind="stable")
             data = {c: cols[c][order] for c in _SCHEMA_COLS}
             for k in cols:
@@ -277,12 +418,15 @@ class _Namespace:
             seq = self._next_seq()
             tmp = os.path.join(self.dir, f".tmp-events-{seq:06d}.parquet")
             pq.write_table(table, tmp)
-            if replace_all:
-                for p in self.part_paths():
-                    os.remove(p)
+            # new part lands atomically BEFORE the folded files go away: a
+            # crash mid-delete leaves transient duplicates (benign, folded
+            # by the next compaction), never data loss
             os.replace(tmp, os.path.join(self.dir, f"events-{seq:06d}.parquet"))
-            if replace_all and os.path.exists(self.wal_path):
-                os.remove(self.wal_path)
+            for p in replaces or ():
+                try:
+                    os.remove(p)
+                except FileNotFoundError:
+                    pass
 
     @staticmethod
     def promote_numeric(cols: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
@@ -310,27 +454,40 @@ class _Namespace:
         return out
 
     def compact(self, force: bool = False):
-        """Fold WAL into a new immutable part (numeric keys promoted).
+        """Fold WALs + parts into one immutable part (numeric keys promoted).
 
-        The threshold check is a stat() on the WAL file — callers can invoke
-        this after every write without paying a parse of the WAL.
+        The threshold check is a stat() on the WAL files — callers can
+        invoke this after every write without paying a parse. Runs under
+        the cross-process parts lock and deletes exactly the files it
+        folded, so writers appending (own WALs, lock-free) or bulk-writing
+        parts (locked) concurrently never lose rows.
         """
         if not force and self.wal_bytes() < WAL_COMPACT_BYTES:
             return
-        with self.lock:
-            wal = self.read_wal()
+        with self.parts_lock():
+            wal_snapshot = self.wal_paths()
+            part_snapshot = self.part_paths()
+            wal = self.read_wal(wal_snapshot)
             if not wal:
                 return
             cols = self.read_columns()  # parts + wal merged, deletes applied
             cols = {k: v for k, v in cols.items() if not k.startswith("numeric:")}
+            # crash-recovery dedup: keep the LAST row per id (a part that
+            # survived a half-finished delete pass may duplicate rows)
+            ids = cols["id"]
+            if len(ids) != len(set(ids)):
+                last = {eid: i for i, eid in enumerate(ids)}
+                keep = np.zeros(len(ids), bool)
+                keep[list(last.values())] = True
+                cols = {k: v[keep] for k, v in cols.items()}
             cols = self.promote_numeric(cols)
-            self.write_part(cols, replace_all=True)
+            self.write_part(cols, replaces=part_snapshot + wal_snapshot)
 
     def all_ids(self) -> set:
         """Live event ids only — id-column scans, no full materialization."""
         import pyarrow.parquet as pq
 
-        with self.lock:
+        with self.parts_lock(shared=True):
             ids: set = set()
             for p in self.part_paths():
                 ids.update(pq.read_table(p, columns=["id"])["id"].to_pylist())
@@ -408,7 +565,7 @@ class ParquetLEvents(base.LEvents):
         import pyarrow.parquet as pq
 
         ns = self._ns(app_id, channel_id)
-        with ns.lock:
+        with ns.parts_lock(shared=True):
             wal = ns.read_wal()
             row = None
             for op in wal:  # WAL wins over parts; later ops win over earlier
@@ -447,8 +604,12 @@ class ParquetLEvents(base.LEvents):
         reversed: bool = False,
     ) -> Iterable[Event]:
         # filter on COLUMNS (vectorized), materialize only matching rows —
-        # serving-time lookups touch a handful of rows, not the whole store
-        cols = self._ns(app_id, channel_id).read_columns()
+        # serving-time lookups touch a handful of rows, not the whole store;
+        # a time range also prunes whole part files via parquet statistics
+        cols = self._ns(app_id, channel_id).read_columns(
+            start_ts=None if start_time is None else _ts(start_time),
+            until_ts=None if until_time is None else _ts(until_time),
+        )
         n = len(cols["id"])
         mask = np.ones(n, dtype=bool)
         if start_time is not None:
@@ -506,7 +667,10 @@ class ParquetPEvents(base.PEvents):
         target_entity_type=None,
         target_entity_id=None,
     ) -> EventBatch:
-        cols = _Namespace(self.root, app_id, channel_id).read_columns()
+        cols = _Namespace(self.root, app_id, channel_id).read_columns(
+            start_ts=None if start_time is None else _ts(start_time),
+            until_ts=None if until_time is None else _ts(until_time),
+        )
         n = len(cols["id"])
         mask = np.ones(n, dtype=bool)
         if start_time is not None:
@@ -593,7 +757,7 @@ class ParquetPEvents(base.PEvents):
             )
         import pyarrow.parquet as pq
 
-        with ns.lock:
+        with ns.parts_lock(shared=True):
             parts = ns.part_paths()
             # a pnum column is trustworthy only if EVERY part carries it
             # (same intersection rule as read_columns: concat null-fill
