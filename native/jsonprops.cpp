@@ -1,0 +1,349 @@
+// Columnar JSON property scanner — the native data-plane kernel behind
+// predictionio_tpu's numeric-property promotion (parquet compaction) and
+// bulk property scans.
+//
+// Role parity: the reference's equivalent tier is JVM-native JSON handling
+// (json4s/Jackson) under its storage drivers; here the hot path is the
+// parquet driver's promote_numeric over tens of millions of small JSON
+// objects, where a per-row Python json.loads costs minutes. This kernel
+// makes one pass over a concatenated buffer of JSON objects and reports,
+// per top-level key:
+//   - a per-row float64 column (NaN where the key is absent) for keys whose
+//     present values are ONLY JSON numbers or booleans (the unambiguous
+//     subset where C and Python coercion agree bit-for-bit), and
+//   - flags: "saw_other" marks keys with null/object/array values or
+//     strings provably not float()-coercible — rejected, exactly as the
+//     Python path rejects them; "saw_string" marks keys with a string that
+//     MIGHT coerce (e.g. "3"), which makes the Python side decline the
+//     whole batch so Python's float() semantics decide.
+//
+// Any malformed line aborts the whole scan (returns NULL) — callers fall
+// back to the Python implementation, so this kernel can be strict.
+//
+// C ABI only (loaded via ctypes; no pybind11 in this image).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct KeyInfo {
+    std::string name;
+    std::vector<double> column;  // per-row values, NaN = missing
+    bool saw_string = false;  // a maybe-coercible string value
+    bool saw_other = false;   // null/object/array/never-coercible string
+};
+
+struct Scan {
+    std::vector<KeyInfo> keys;
+    std::unordered_map<std::string, size_t> index;
+    int64_t nrows = 0;
+};
+
+struct Cursor {
+    const char* p;
+    const char* end;
+    bool ok = true;
+
+    void ws() {
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+            ++p;
+    }
+    bool eat(char c) {
+        ws();
+        if (p < end && *p == c) {
+            ++p;
+            return true;
+        }
+        ok = false;
+        return false;
+    }
+    bool peek(char c) {
+        ws();
+        return p < end && *p == c;
+    }
+};
+
+// Scan past a JSON string (opening quote consumed), appending the raw
+// (still-escaped) contents to *out when non-null. Returns false on error.
+bool skip_string(Cursor& c, std::string* out) {
+    while (c.p < c.end) {
+        char ch = *c.p++;
+        if (ch == '"') return true;
+        if (ch == '\\') {
+            if (c.p >= c.end) return false;
+            if (out) {
+                out->push_back('\\');
+                out->push_back(*c.p);
+            }
+            ++c.p;
+            continue;
+        }
+        if (out) out->push_back(ch);
+    }
+    return false;
+}
+
+// Minimal unescape for object KEYS (values never need their text here).
+// json.dumps(ensure_ascii=True) emits \uXXXX for non-ASCII; decode the BMP
+// cases to UTF-8 so key names match Python's. Surrogate pairs are rare in
+// keys — on encountering one, fail the scan (Python fallback handles it).
+bool unescape_key(const std::string& raw, std::string& out) {
+    out.clear();
+    for (size_t i = 0; i < raw.size(); ++i) {
+        char ch = raw[i];
+        if (ch != '\\') {
+            out.push_back(ch);
+            continue;
+        }
+        if (++i >= raw.size()) return false;
+        switch (raw[i]) {
+            case '"': out.push_back('"'); break;
+            case '\\': out.push_back('\\'); break;
+            case '/': out.push_back('/'); break;
+            case 'b': out.push_back('\b'); break;
+            case 'f': out.push_back('\f'); break;
+            case 'n': out.push_back('\n'); break;
+            case 'r': out.push_back('\r'); break;
+            case 't': out.push_back('\t'); break;
+            case 'u': {
+                if (i + 4 >= raw.size()) return false;
+                unsigned cp = 0;
+                for (int k = 1; k <= 4; ++k) {
+                    char h = raw[i + k];
+                    cp <<= 4;
+                    if (h >= '0' && h <= '9') cp |= h - '0';
+                    else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+                    else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+                    else return false;
+                }
+                i += 4;
+                if (cp == 0) return false;  // NUL would truncate the C name
+                if (cp >= 0xD800 && cp <= 0xDFFF) return false;  // surrogate
+                if (cp < 0x80) {
+                    out.push_back(static_cast<char>(cp));
+                } else if (cp < 0x800) {
+                    out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+                    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+                } else {
+                    out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+                    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+                    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+                }
+                break;
+            }
+            default:
+                return false;
+        }
+    }
+    return true;
+}
+
+// Could this raw (escaped) string content be float()-coercible in Python?
+// Conservative: any escape sequence, or any character that can appear in a
+// Python float literal (digits, sign, '.', exponent, underscores, the
+// letters of inf/infinity/nan, whitespace) keeps it "maybe"; one character
+// outside that alphabet (most labels/categories/ids have one) proves it can
+// never coerce — Python would reject the key, and so can we.
+bool string_maybe_coercible(const std::string& raw) {
+    if (raw.empty()) return true;  // float("") raises, but stay conservative
+    for (char ch : raw) {
+        if (ch == '\\') return true;  // escaped char: don't reason about it
+        if ((ch >= '0' && ch <= '9') || ch == '+' || ch == '-' || ch == '.' ||
+            ch == '_' || ch == ' ' || ch == '\t' || ch == '\n' || ch == '\r')
+            continue;
+        switch (ch) {  // i n f a t y (inf / infinity / nan), either case
+            case 'i': case 'n': case 'f': case 'a': case 't': case 'y':
+            case 'e': case 'E':
+            case 'I': case 'N': case 'F': case 'A': case 'T': case 'Y':
+                continue;
+            default:
+                return false;
+        }
+    }
+    return true;
+}
+
+// Skip a JSON value of any type. When the value is a number or boolean,
+// set *num and return kind 1; possibly-float-coercible string → kind 2;
+// null/object/array or never-coercible string → kind 3 (key rejected,
+// matching Python). Returns 0 on parse error.
+int skip_value(Cursor& c, double* num) {
+    c.ws();
+    if (c.p >= c.end) return 0;
+    char ch = *c.p;
+    if (ch == '"') {
+        ++c.p;
+        static thread_local std::string content;
+        content.clear();
+        if (!skip_string(c, &content)) return 0;
+        return string_maybe_coercible(content) ? 2 : 3;
+    }
+    if (ch == 't') {
+        if (c.end - c.p >= 4 && std::memcmp(c.p, "true", 4) == 0) {
+            c.p += 4;
+            *num = 1.0;
+            return 1;
+        }
+        return 0;
+    }
+    if (ch == 'f') {
+        if (c.end - c.p >= 5 && std::memcmp(c.p, "false", 5) == 0) {
+            c.p += 5;
+            *num = 0.0;
+            return 1;
+        }
+        return 0;
+    }
+    if (ch == 'n') {
+        if (c.end - c.p >= 4 && std::memcmp(c.p, "null", 4) == 0) {
+            c.p += 4;
+            return 3;
+        }
+        return 0;
+    }
+    if (ch == '{' || ch == '[') {
+        int depth = 0;
+        while (c.p < c.end) {
+            char d = *c.p++;
+            if (d == '"') {
+                if (!skip_string(c, nullptr)) return 0;
+            } else if (d == '{' || d == '[') {
+                ++depth;
+            } else if (d == '}' || d == ']') {
+                if (--depth == 0) return 3;
+            }
+        }
+        return 0;
+    }
+    // number: JSON numeric literals are a strict strtod subset, and
+    // json.dumps never emits NaN/Infinity without allow_nan tricks — but a
+    // client may have; strtod accepts them, Python float() too, so parity
+    // holds. Reject hex ('0x...') which strtod takes but JSON forbids.
+    if (ch == '-' || (ch >= '0' && ch <= '9')) {
+        if (c.end - c.p >= 2 && c.p[0] == '0' &&
+            (c.p[1] == 'x' || c.p[1] == 'X'))
+            return 0;
+        char* endp = nullptr;
+        // NOTE: buffer is not NUL-terminated per line, but strtod stops at
+        // the first non-numeric char (',' '}' ws), all of which terminate a
+        // JSON number; the caller guarantees the overall buffer ends with
+        // a closing '}' of the last object, never a bare number.
+        *num = std::strtod(c.p, &endp);
+        if (endp == c.p) return 0;
+        c.p = endp;
+        return 1;
+    }
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan `nrows` JSON objects laid out back-to-back in buf; offsets[i] /
+// offsets[i+1] delimit row i (offsets has nrows+1 entries — exactly an
+// Arrow string column's layout). Returns an opaque handle, or NULL if any
+// row fails to parse (caller uses its fallback).
+void* pio_props_scan(const char* buf, const int64_t* offsets, int64_t nrows) {
+    auto* scan = new Scan();
+    scan->nrows = nrows;
+    std::string raw_key, key;
+    for (int64_t row = 0; row < nrows; ++row) {
+        Cursor c{buf + offsets[row], buf + offsets[row + 1]};
+        c.ws();
+        if (c.p == c.end) continue;  // empty properties cell
+        if (!c.eat('{')) {
+            delete scan;
+            return nullptr;
+        }
+        if (c.peek('}')) {
+            ++c.p;
+            continue;
+        }
+        while (true) {
+            if (!c.eat('"')) {
+                delete scan;
+                return nullptr;
+            }
+            raw_key.clear();
+            if (!skip_string(c, &raw_key) || !unescape_key(raw_key, key)) {
+                delete scan;
+                return nullptr;
+            }
+            if (!c.eat(':')) {
+                delete scan;
+                return nullptr;
+            }
+            double num = 0.0;
+            int kind = skip_value(c, &num);
+            if (kind == 0) {
+                delete scan;
+                return nullptr;
+            }
+            auto it = scan->index.find(key);
+            size_t ki;
+            if (it == scan->index.end()) {
+                ki = scan->keys.size();
+                scan->index.emplace(key, ki);
+                scan->keys.emplace_back();
+                scan->keys[ki].name = key;
+                scan->keys[ki].column.assign(
+                    static_cast<size_t>(nrows), std::nan(""));
+            } else {
+                ki = it->second;
+            }
+            KeyInfo& info = scan->keys[ki];
+            if (kind == 1) {
+                // duplicate keys in one object: last wins (json.loads parity)
+                info.column[static_cast<size_t>(row)] = num;
+            } else if (kind == 2) {
+                info.saw_string = true;
+            } else {
+                info.saw_other = true;
+            }
+            if (c.peek(',')) {
+                ++c.p;
+                continue;
+            }
+            if (c.eat('}')) break;
+            delete scan;
+            return nullptr;
+        }
+        c.ws();
+        if (c.p != c.end) {  // trailing garbage in the row
+            delete scan;
+            return nullptr;
+        }
+    }
+    return scan;
+}
+
+int64_t pio_props_nkeys(void* h) {
+    return static_cast<int64_t>(static_cast<Scan*>(h)->keys.size());
+}
+
+const char* pio_props_key_name(void* h, int64_t i) {
+    return static_cast<Scan*>(h)->keys[static_cast<size_t>(i)].name.c_str();
+}
+
+// Bit 0: saw_string (a maybe-coercible string → caller must decline),
+// bit 1: saw_other (null/object/array/never-coercible string → key rejected).
+int32_t pio_props_key_flags(void* h, int64_t i) {
+    const KeyInfo& k = static_cast<Scan*>(h)->keys[static_cast<size_t>(i)];
+    return (k.saw_string ? 1 : 0) | (k.saw_other ? 2 : 0);
+}
+
+// Pointer to the per-row float64 column for key i (length = nrows).
+const double* pio_props_key_column(void* h, int64_t i) {
+    return static_cast<Scan*>(h)->keys[static_cast<size_t>(i)].column.data();
+}
+
+void pio_props_free(void* h) { delete static_cast<Scan*>(h); }
+
+}  // extern "C"

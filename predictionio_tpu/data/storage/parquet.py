@@ -42,8 +42,18 @@ from predictionio_tpu.data.storage import base
 UTC = _dt.timezone.utc
 
 # one WAL file per writer process: concurrent event servers / importers on a
-# shared filesystem never interleave within a file
-_WRITER_TOKEN = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
+# shared filesystem never interleave within a file. Derived lazily and
+# re-derived after fork() — a forked worker must not inherit its parent's
+# WAL filename or the no-interleave invariant breaks.
+_WRITER_TOKEN: Optional[tuple[int, str]] = None
+
+
+def _writer_token() -> str:
+    global _WRITER_TOKEN
+    pid = os.getpid()
+    if _WRITER_TOKEN is None or _WRITER_TOKEN[0] != pid:
+        _WRITER_TOKEN = (pid, f"{pid}-{uuid.uuid4().hex[:6]}")
+    return _WRITER_TOKEN[1]
 
 
 def _ts(d: _dt.datetime) -> float:
@@ -182,11 +192,20 @@ class _Namespace:
     """One (app, channel) directory of parts + per-writer WALs."""
 
     def __init__(self, root: str, app_id: int, channel_id: Optional[int]):
+        self.root = root
         cid = 0 if channel_id is None else channel_id
-        self.dir = os.path.join(root, f"app_{app_id}_ch_{cid}")
-        # this process's own WAL; readers merge every wal*.jsonl in the dir
-        self.wal_path = os.path.join(self.dir, f"wal-{_WRITER_TOKEN}.jsonl")
+        self.name = f"app_{app_id}_ch_{cid}"
+        self.dir = os.path.join(root, self.name)
         self.lock = _lock_for(self.dir)
+
+    @property
+    def wal_path(self) -> str:
+        """This process's own WAL; readers merge every wal*.jsonl here.
+
+        A property (not set in __init__) so a forked child resolves to its
+        OWN file the first time it writes.
+        """
+        return os.path.join(self.dir, f"wal-{_writer_token()}.jsonl")
 
     def ensure(self):
         os.makedirs(self.dir, exist_ok=True)
@@ -223,7 +242,10 @@ class _Namespace:
                 finally:
                     _FLOCK_DEPTH[self.dir] = depth
                 return
-            with open(os.path.join(self.dir, ".parts.lock"), "a") as lf:
+            # the lock file lives OUTSIDE the namespace dir so wipe()'s
+            # rmtree cannot delete it out from under a concurrent holder
+            # (a fresh inode at the same path would not exclude anyone)
+            with open(os.path.join(self.root, f".{self.name}.lock"), "a") as lf:
                 fcntl.flock(lf, fcntl.LOCK_SH if shared else fcntl.LOCK_EX)
                 _FLOCK_DEPTH[self.dir] = 1
                 try:
@@ -435,7 +457,20 @@ class _Namespace:
         A key is promoted only when EVERY present value coerces with
         ``float`` — so the promoted column reproduces the JSON fallback
         exactly (uncoercible values keep the key on the JSON path, matching
-        other backends' behavior including its errors)."""
+        other backends' behavior including its errors).
+
+        The native columnar scanner (``native/jsonprops.cpp``) handles the
+        common case (values are JSON numbers/booleans) in one C pass; it
+        declines batches containing string-typed numerics or malformed
+        rows, which then take this exact-semantics Python path."""
+        from predictionio_tpu import native
+
+        scanned = native.scan_numeric_props(cols["properties"])
+        if scanned is not None:
+            out = dict(cols)
+            for k, col in scanned.items():
+                out[f"numeric:{k}"] = col
+            return out
         parsed = [json.loads(p) if p else {} for p in cols["properties"]]
         candidates: set = set()
         rejected: set = set()
@@ -501,7 +536,9 @@ class _Namespace:
     def wipe(self):
         import shutil
 
-        with self.lock:
+        # exclusive: a concurrent compactor/writer must finish (and then
+        # fail cleanly on the vanished dir) rather than race the rmtree
+        with self.parts_lock():
             if self.exists():
                 shutil.rmtree(self.dir)
 
@@ -797,6 +834,18 @@ class ParquetPEvents(base.PEvents):
         add(pc.is_valid(t.column("target_entity_id")))
         if mask is not None:
             t = t.filter(mask)
+        if t.num_rows == 0:
+            # nothing matched (e.g. a store of only $set events): explicit
+            # empty result — an all-null Arrow column has type null, which
+            # dictionary_encode cannot handle
+            return Interactions(
+                user=np.empty(0, np.int32),
+                item=np.empty(0, np.int32),
+                rating=np.empty(0, np.float32),
+                t=np.empty(0, np.float64),
+                user_map=BiMap({}),
+                item_map=BiMap({}),
+            )
 
         def encode(col):
             enc = pc.dictionary_encode(t.column(col)).combine_chunks()

@@ -1,0 +1,152 @@
+"""ctypes bindings for the native data-plane kernels under ``native/``.
+
+The compute plane is JAX/XLA; these kernels cover the *data* plane's
+CPU-bound hot spots — currently the columnar JSON property scan behind
+``parquet.promote_numeric`` (tens of millions of small JSON objects per
+compaction, where per-row ``json.loads`` costs minutes).
+
+Design rules:
+
+* Pure C ABI loaded via ctypes (this image has no pybind11).
+* The library is built lazily from ``native/*.cpp`` with ``g++`` the first
+  time it is needed and cached beside the sources; no compiler → the
+  Python implementations are used silently.
+* Kernels are STRICT: anything surprising (malformed JSON, nulls,
+  string-typed numerics) makes them decline the whole batch, and callers
+  run their exact-semantics Python path instead. A kernel may be fast or
+  absent, never subtly different.
+* ``PIO_NATIVE=0`` disables all native kernels (env kill switch).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libpioprops.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "jsonprops.cpp")
+
+_lib = None
+_lib_tried = False
+_lib_lock = threading.Lock()
+
+
+def _build() -> bool:
+    """Compile the kernel library; True on success."""
+    gxx = os.environ.get("CXX") or "g++"
+    try:
+        subprocess.run(
+            [gxx, "-O3", "-Wall", "-shared", "-fPIC", "-o", _SO_PATH, _SRC_PATH],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.info("native kernel build unavailable (%s); using Python paths", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The kernel library, building it on first use; None when unavailable."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if os.environ.get("PIO_NATIVE", "1") == "0":
+            return None
+        if not os.path.exists(_SO_PATH) or (
+            os.path.exists(_SRC_PATH)
+            and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)
+        ):
+            if not os.path.exists(_SRC_PATH) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            logger.info("native kernel load failed (%s); using Python paths", e)
+            return None
+        lib.pio_props_scan.restype = ctypes.c_void_p
+        lib.pio_props_scan.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+        ]
+        lib.pio_props_nkeys.restype = ctypes.c_int64
+        lib.pio_props_nkeys.argtypes = [ctypes.c_void_p]
+        lib.pio_props_key_name.restype = ctypes.c_char_p
+        lib.pio_props_key_name.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pio_props_key_flags.restype = ctypes.c_int32
+        lib.pio_props_key_flags.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pio_props_key_column.restype = ctypes.POINTER(ctypes.c_double)
+        lib.pio_props_key_column.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pio_props_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def scan_numeric_props(props) -> Optional[dict[str, np.ndarray]]:
+    """Columnar float64 columns for promotable numeric property keys.
+
+    ``props`` is a sequence of JSON-object strings (one per row). Returns
+    {key: (nrows,) float64 array, NaN where absent} covering exactly the
+    keys whose present values are all JSON numbers or booleans — the
+    subset where C and Python coercion agree bit-for-bit. Keys with
+    null/object/array values, or strings that provably cannot coerce with
+    ``float`` (most labels/ids), are rejected exactly as the Python path
+    rejects them. Returns None (caller must use its Python path) when the
+    kernel is unavailable, any row fails to parse, any cell is null, or a
+    string value MIGHT be float-coercible (e.g. ``"3"`` — Python's
+    coercion semantics must decide).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    import pyarrow as pa
+
+    try:
+        # large_string = int64 offsets + one contiguous UTF-8 buffer: the
+        # exact layout the C ABI takes, no per-row Python objects. The
+        # sentinel "{}" row guarantees any malformed trailing number in the
+        # last real row terminates inside the buffer.
+        arr = pa.array(list(props) + ["{}"], type=pa.large_string())
+    except (pa.ArrowInvalid, pa.ArrowTypeError, TypeError):
+        return None
+    if arr.null_count:
+        return None
+    _validity, offsets_buf, data_buf = arr.buffers()
+    offsets = np.frombuffer(offsets_buf, dtype=np.int64)
+    n = len(props)
+    handle = lib.pio_props_scan(
+        data_buf.address,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+    )
+    if not handle:
+        return None
+    try:
+        out: dict[str, np.ndarray] = {}
+        for i in range(lib.pio_props_nkeys(handle)):
+            flags = lib.pio_props_key_flags(handle, i)
+            if flags & 1:  # saw a string value: Python coercion semantics
+                return None
+            if flags & 2:  # null/object/array: key is not promotable
+                continue
+            name = lib.pio_props_key_name(handle, i).decode("utf-8")
+            col_ptr = lib.pio_props_key_column(handle, i)
+            out[name] = np.ctypeslib.as_array(col_ptr, shape=(n,)).copy()
+        return out
+    finally:
+        lib.pio_props_free(handle)

@@ -118,3 +118,30 @@ class TestFindInteractions:
         pe = ParquetPEvents(path=str(tmp_path))
         inter = pe.find_interactions(1, event_names=["rate"])
         assert len(inter) == 0
+
+    def test_store_with_only_set_events(self, tmp_path):
+        """$set events have null targets; an all-null Arrow column must not
+        crash the fast path — the result is just empty."""
+        import datetime as dt
+
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.parquet import ParquetPEvents
+
+        pe = ParquetPEvents(path=str(tmp_path))
+        pe.write(
+            [
+                Event(
+                    event="$set", entity_type="item", entity_id=f"i{k}",
+                    properties={"rating": 1.0},
+                    event_time=dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc),
+                )
+                # enough for a direct part write (no WAL): the Arrow path
+                for k in range(ParquetPEvents.DIRECT_PART_THRESHOLD)
+            ],
+            1,
+        )
+        inter = pe.find_interactions(
+            1, entity_type="item", rating_key="rating"
+        )
+        assert len(inter) == 0
+        assert len(inter.user_map) == 0 and len(inter.item_map) == 0

@@ -1,0 +1,235 @@
+"""Native columnar JSON property scanner: exact parity with the Python path.
+
+The kernel (native/jsonprops.cpp) must be fast or absent — never subtly
+different: any batch it accepts must produce bit-identical promotion
+results to parquet's Python implementation, and anything surprising must
+make it decline (return None) so the Python path runs.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("no C++ toolchain in this environment")
+    return lib
+
+
+def python_reference(props):
+    """The exact Python promotion semantics, lifted from parquet.py."""
+    from predictionio_tpu.data.storage.parquet import (
+        _coerce_numeric,
+        _value_coercible,
+    )
+
+    parsed = [json.loads(p) if p else {} for p in props]
+    candidates, rejected = set(), set()
+    for p in parsed:
+        for k, v in p.items():
+            (candidates if _value_coercible(v) else rejected).add(k)
+    return {
+        k: np.array(
+            [_coerce_numeric(p[k]) if k in p else np.nan for p in parsed],
+            dtype=np.float64,
+        )
+        for k in candidates - rejected
+    }
+
+
+def assert_parity(props):
+    got = native.scan_numeric_props(np.array(props, dtype=object))
+    want = python_reference(props)
+    assert got is not None
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])  # NaN == NaN here
+
+
+class TestParity:
+    def test_numbers_bools_missing_keys(self, lib):
+        rows = [
+            {"rating": 4.5, "count": 3, "flag": True},
+            {"rating": -1e-3, "flag": False},
+            {"count": 12345678901234},
+            {},
+            {"rating": 0},
+        ]
+        assert_parity([json.dumps(r) for r in rows])
+
+    def test_rejected_kinds_null_object_array(self, lib):
+        rows = [
+            {"a": 1, "b": None, "c": {"x": 1}, "d": [1, 2]},
+            {"a": 2.5, "b": 3, "c": 1, "d": 2},
+        ]
+        # b/c/d each saw an uncoercible value → not promoted, a promoted
+        assert_parity([json.dumps(r) for r in rows])
+
+    def test_unicode_and_escaped_keys(self, lib):
+        rows = [
+            {"prix€": 9.5, 'quo"te': 1, "tab\tkey": 2, "日本語": 3},
+            {"prix€": 1.5, "日本語": 4},
+        ]
+        # both ensure_ascii styles must parse to the same columns
+        assert_parity([json.dumps(r) for r in rows])
+        assert_parity([json.dumps(r, ensure_ascii=False) for r in rows])
+
+    def test_duplicate_key_last_wins(self, lib):
+        props = ['{"a": 1, "a": 2}', '{"a": 7}']
+        got = native.scan_numeric_props(np.array(props, dtype=object))
+        assert got is not None and got["a"].tolist() == [2.0, 7.0]
+
+    def test_number_formats(self, lib):
+        rows = [
+            {"x": 1e308, "y": -0.0, "z": 2e-308},
+            {"x": 1.7976931348623157e308, "y": 3.141592653589793, "z": 1e5},
+        ]
+        assert_parity([json.dumps(r) for r in rows])
+
+    def test_empty_and_whitespace_rows(self, lib):
+        assert_parity(["", "{}", '  {"a": 1}  ', '{"a": 2}'])
+
+    def test_fuzz_random_dicts(self, lib):
+        rng = np.random.default_rng(0)
+        keys = ["k%d" % i for i in range(8)] + ["ключ", "k w s"]
+        rows = []
+        for _ in range(500):
+            row = {}
+            for k in keys:
+                r = rng.random()
+                if r < 0.4:
+                    continue
+                elif r < 0.7:
+                    row[k] = float(
+                        rng.normal() * 10.0 ** float(rng.integers(-3, 6))
+                    )
+                elif r < 0.8:
+                    row[k] = int(rng.integers(-(2**40), 2**40))
+                elif r < 0.9:
+                    row[k] = bool(rng.random() < 0.5)
+                elif r < 0.95:
+                    # provably-uncoercible string ('l'/'b' disqualify it):
+                    # rejects the key, must not decline the batch
+                    row[k] = "lbl%d" % int(rng.integers(100))
+                else:
+                    row[k] = {"nested": 1} if r < 0.975 else None
+            rows.append(row)
+        assert_parity([json.dumps(r) for r in rows])
+
+
+class TestDecline:
+    """Surprising inputs must yield None (Python path), never wrong columns."""
+
+    def test_maybe_coercible_string_declines(self, lib):
+        # "3" is float()-coercible in Python; the kernel must hand over
+        assert (
+            native.scan_numeric_props(np.array(['{"a": "3"}'], object)) is None
+        )
+        # so must inf/nan-ish and underscore-y strings
+        for s in ('"inf"', '"-Infinity"', '" nan "', '"1_0"', '""'):
+            assert (
+                native.scan_numeric_props(
+                    np.array(['{"a": %s}' % s], object)
+                )
+                is None
+            ), s
+
+    def test_never_coercible_strings_reject_key_only(self, lib):
+        """Typical string properties (labels, ids) must NOT kill the fast
+        path: the key is rejected like Python rejects it, numbers elsewhere
+        still promote natively."""
+        props = [
+            '{"label": "category x", "rating": 4.0}',
+            '{"label": "wid/get#9", "rating": 2.0}',
+        ]
+        got = native.scan_numeric_props(np.array(props, object))
+        assert got is not None
+        assert set(got) == {"rating"}
+        assert got["rating"].tolist() == [4.0, 2.0]
+        assert_parity(props)
+
+    def test_malformed_row_declines(self, lib):
+        assert (
+            native.scan_numeric_props(
+                np.array(['{"a": 1}', '{"a": '], object)
+            )
+            is None
+        )
+
+    def test_nan_literal_declines(self, lib):
+        # json.dumps(float("nan")) emits a bare NaN literal
+        assert (
+            native.scan_numeric_props(np.array(['{"a": NaN}'], object)) is None
+        )
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("PIO_NATIVE", "0")
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_lib_tried", False)
+        assert native.load() is None
+        assert native.scan_numeric_props(np.array(["{}"], object)) is None
+
+
+def test_promote_numeric_uses_native_and_matches_python(lib, monkeypatch):
+    """End-to-end through parquet.promote_numeric, both engines — with a
+    spy proving the native path actually handled the batch."""
+    from predictionio_tpu.data.storage.parquet import _Namespace
+
+    rows = [
+        {"rating": float(i % 5), "label": "x%d" % i, "ok": i % 2 == 0}
+        for i in range(50)
+    ]
+    cols = {"properties": np.array([json.dumps(r) for r in rows], object)}
+    calls = []
+    real = native.scan_numeric_props
+
+    def spy(props):
+        out = real(props)
+        calls.append(out is not None)
+        return out
+
+    monkeypatch.setattr(native, "scan_numeric_props", spy)
+    with_native = _Namespace.promote_numeric(dict(cols))
+    assert calls == [True], "native scanner did not accept the batch"
+    monkeypatch.setattr(native, "scan_numeric_props", lambda props: None)
+    with_python = _Namespace.promote_numeric(dict(cols))
+    assert set(with_native) == set(with_python)
+    np.testing.assert_array_equal(
+        with_native["numeric:rating"], with_python["numeric:rating"]
+    )
+    np.testing.assert_array_equal(
+        with_native["numeric:ok"], with_python["numeric:ok"]
+    )
+    assert "numeric:label" not in with_native
+
+
+def test_throughput_info(lib):
+    """Informational: print native vs Python scan rate (no assertion)."""
+    import time
+
+    rows = [
+        json.dumps({"rating": i % 5 + 0.5, "views": i, "buy": i % 3 == 0})
+        for i in range(100_000)
+    ]
+    arr = np.array(rows, dtype=object)
+    t0 = time.perf_counter()
+    native_out = native.scan_numeric_props(arr)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    python_out = python_reference(rows)
+    t_python = time.perf_counter() - t0
+    assert native_out is not None
+    np.testing.assert_array_equal(
+        native_out["rating"], python_out["rating"]
+    )
+    print(
+        f"\nnative: {len(rows)/t_native/1e6:.1f}M rows/s, "
+        f"python: {len(rows)/t_python/1e6:.2f}M rows/s, "
+        f"speedup {t_python/t_native:.1f}x"
+    )
