@@ -26,6 +26,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -34,7 +35,10 @@ namespace {
 
 struct KeyInfo {
     std::string name;
-    std::vector<double> column;  // per-row values, NaN = missing
+    // allocated lazily on the first numeric value — rejected keys (string
+    // labels etc.) must not cost nrows×8B each at 25M-row scale
+    std::vector<double> column;
+    int64_t last_row = -1;    // duplicate-key-in-one-object detection
     bool saw_string = false;  // a maybe-coercible string value
     bool saw_other = false;   // null/object/array/never-coercible string
 };
@@ -153,6 +157,8 @@ bool unescape_key(const std::string& raw, std::string& out) {
 bool string_maybe_coercible(const std::string& raw) {
     if (raw.empty()) return true;  // float("") raises, but stay conservative
     for (char ch : raw) {
+        if (static_cast<unsigned char>(ch) >= 0x80)
+            return true;  // Python float() accepts non-ASCII digits/spaces
         if (ch == '\\') return true;  // escaped char: don't reason about it
         if ((ch >= '0' && ch <= '9') || ch == '+' || ch == '-' || ch == '.' ||
             ch == '_' || ch == ' ' || ch == '\t' || ch == '\n' || ch == '\r')
@@ -221,22 +227,39 @@ int skip_value(Cursor& c, double* num) {
         }
         return 0;
     }
-    // number: JSON numeric literals are a strict strtod subset, and
-    // json.dumps never emits NaN/Infinity without allow_nan tricks — but a
-    // client may have; strtod accepts them, Python float() too, so parity
-    // holds. Reject hex ('0x...') which strtod takes but JSON forbids.
+    // number: validate the token against the JSON grammar FIRST — strtod
+    // alone also accepts hex, 'inf', '1.' etc., forms json.loads rejects,
+    // and accepting them would serve corrupted rows as data instead of
+    // surfacing the error the Python path raises.
     if (ch == '-' || (ch >= '0' && ch <= '9')) {
-        if (c.end - c.p >= 2 && c.p[0] == '0' &&
-            (c.p[1] == 'x' || c.p[1] == 'X'))
-            return 0;
+        const char* s = c.p;
+        if (*s == '-') ++s;
+        if (s >= c.end || *s < '0' || *s > '9') return 0;
+        if (*s == '0') {
+            ++s;
+        } else {
+            while (s < c.end && *s >= '0' && *s <= '9') ++s;
+        }
+        if (s < c.end && *s == '.') {
+            ++s;
+            if (s >= c.end || *s < '0' || *s > '9') return 0;
+            while (s < c.end && *s >= '0' && *s <= '9') ++s;
+        }
+        if (s < c.end && (*s == 'e' || *s == 'E')) {
+            ++s;
+            if (s < c.end && (*s == '+' || *s == '-')) ++s;
+            if (s >= c.end || *s < '0' || *s > '9') return 0;
+            while (s < c.end && *s >= '0' && *s <= '9') ++s;
+        }
+        // strtod on the validated span, pinned to the C locale — a host app
+        // that setlocale()s to a ','-decimal locale must not change results
+        static locale_t c_locale = newlocale(LC_ALL_MASK, "C", nullptr);
+        static thread_local std::string token;
+        token.assign(c.p, s);  // NUL-terminated copy of just the literal
         char* endp = nullptr;
-        // NOTE: buffer is not NUL-terminated per line, but strtod stops at
-        // the first non-numeric char (',' '}' ws), all of which terminate a
-        // JSON number; the caller guarantees the overall buffer ends with
-        // a closing '}' of the last object, never a bare number.
-        *num = std::strtod(c.p, &endp);
-        if (endp == c.p) return 0;
-        c.p = endp;
+        *num = strtod_l(token.c_str(), &endp, c_locale);
+        if (endp != token.c_str() + token.size()) return 0;
+        c.p = s;
         return 1;
     }
     return 0;
@@ -256,8 +279,8 @@ void* pio_props_scan(const char* buf, const int64_t* offsets, int64_t nrows) {
     std::string raw_key, key;
     for (int64_t row = 0; row < nrows; ++row) {
         Cursor c{buf + offsets[row], buf + offsets[row + 1]};
-        c.ws();
-        if (c.p == c.end) continue;  // empty properties cell
+        if (c.p == c.end) continue;  // empty cell: json path treats as {}
+        // whitespace-ONLY cells are a json.loads error, not {} — decline
         if (!c.eat('{')) {
             delete scan;
             return nullptr;
@@ -293,19 +316,31 @@ void* pio_props_scan(const char* buf, const int64_t* offsets, int64_t nrows) {
                 scan->index.emplace(key, ki);
                 scan->keys.emplace_back();
                 scan->keys[ki].name = key;
-                scan->keys[ki].column.assign(
-                    static_cast<size_t>(nrows), std::nan(""));
             } else {
                 ki = it->second;
             }
             KeyInfo& info = scan->keys[ki];
+            if (info.last_row == row) {
+                // duplicate key in one object: json.loads keeps only the
+                // LAST value; replicating that for the flags is subtle, so
+                // decline — Python's semantics decide
+                delete scan;
+                return nullptr;
+            }
+            info.last_row = row;
             if (kind == 1) {
-                // duplicate keys in one object: last wins (json.loads parity)
-                info.column[static_cast<size_t>(row)] = num;
+                if (!info.saw_other) {
+                    if (info.column.empty())
+                        info.column.assign(
+                            static_cast<size_t>(nrows), std::nan(""));
+                    info.column[static_cast<size_t>(row)] = num;
+                }
             } else if (kind == 2) {
                 info.saw_string = true;
             } else {
                 info.saw_other = true;
+                info.column.clear();  // rejected: release, never read again
+                info.column.shrink_to_fit();
             }
             if (c.peek(',')) {
                 ++c.p;

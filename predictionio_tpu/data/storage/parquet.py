@@ -14,7 +14,9 @@ safe for concurrent writer processes sharing the directory — appends never
 interleave across files); reads merge parts + all WALs with delete
 tombstones applied; ``compact()`` folds the WALs into a new part
 (auto-triggered past a threshold), serialized across processes by an
-``flock`` on ``.parts.lock`` and deleting exactly the files it folded.
+``flock`` on ``<path>/.<namespace>.lock`` (outside the namespace dir so a
+wipe cannot delete it from under a holder) and deleting exactly the files
+it folded.
 ``PEvents.find`` materializes the :class:`EventBatch` straight from Arrow
 columns — no per-row Event objects on the bulk path.
 

@@ -146,6 +146,8 @@ def scan_numeric_props(props) -> Optional[dict[str, np.ndarray]]:
                 continue
             name = lib.pio_props_key_name(handle, i).decode("utf-8")
             col_ptr = lib.pio_props_key_column(handle, i)
+            if not col_ptr:  # defensive: a clean key always has a column
+                return None
             out[name] = np.ctypeslib.as_array(col_ptr, shape=(n,)).copy()
         return out
     finally:

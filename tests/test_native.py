@@ -80,10 +80,19 @@ class TestParity:
         assert_parity([json.dumps(r) for r in rows])
         assert_parity([json.dumps(r, ensure_ascii=False) for r in rows])
 
-    def test_duplicate_key_last_wins(self, lib):
-        props = ['{"a": 1, "a": 2}', '{"a": 7}']
-        got = native.scan_numeric_props(np.array(props, dtype=object))
-        assert got is not None and got["a"].tolist() == [2.0, 7.0]
+    def test_duplicate_key_declines(self, lib):
+        """json.loads keeps only the LAST value of a duplicated key — the
+        kernel declines rather than replicate that for the reject flags
+        (e.g. '{"a": null, "a": 3}' promotes a=[3.0] in Python)."""
+        for props in (
+            ['{"a": 1, "a": 2}'],
+            ['{"a": null, "a": 3}'],
+            ['{"a": "x(", "a": 3}'],
+        ):
+            assert (
+                native.scan_numeric_props(np.array(props, dtype=object))
+                is None
+            ), props
 
     def test_number_formats(self, lib):
         rows = [
@@ -167,6 +176,48 @@ class TestDecline:
         assert (
             native.scan_numeric_props(np.array(['{"a": NaN}'], object)) is None
         )
+
+    def test_non_json_number_forms_decline(self, lib):
+        """strtod-isms that json.loads rejects must not become data."""
+        for lit in ("-0x10", "0x10", "1.", ".5", "-inf", "Infinity",
+                    "01", "+1", "1e", "1e+"):
+            assert (
+                native.scan_numeric_props(
+                    np.array(['{"a": %s}' % lit], object)
+                )
+                is None
+            ), lit
+
+    def test_whitespace_only_cell_declines(self, lib):
+        # json.loads("   ") raises; only the truly-empty cell means {}
+        assert (
+            native.scan_numeric_props(np.array(["   ", '{"a":1}'], object))
+            is None
+        )
+        got = native.scan_numeric_props(np.array(["", '{"a":1}'], object))
+        assert got is not None and got["a"].tolist()[1] == 1.0
+
+    def test_non_ascii_string_value_declines(self, lib):
+        # float("٣") == 3.0 in Python: a non-ASCII string value must be
+        # "maybe coercible" (decline), never "provably not"
+        props = ['{"a": "٣"}']
+        assert native.scan_numeric_props(np.array(props, object)) is None
+
+    def test_locale_independent_decimal_parse(self, lib):
+        import locale
+
+        old = locale.setlocale(locale.LC_NUMERIC)
+        try:
+            locale.setlocale(locale.LC_NUMERIC, "de_DE.UTF-8")
+        except locale.Error:
+            pytest.skip("de_DE locale not installed")
+        try:
+            got = native.scan_numeric_props(
+                np.array(['{"a": 4.5}'], object)
+            )
+            assert got is not None and got["a"].tolist() == [4.5]
+        finally:
+            locale.setlocale(locale.LC_NUMERIC, old)
 
     def test_kill_switch(self, monkeypatch):
         monkeypatch.setenv("PIO_NATIVE", "0")
