@@ -20,18 +20,32 @@ import numpy as np
 NORTH_STAR_EVENTS_PER_SEC_PER_CHIP = 25_000_000 * 20 / (60 * 16)
 
 
-def _device_backend_alive(timeout_s: int = 120) -> bool:
+def _device_backend_alive(timeout_s: int = 120, attempts: int = 3) -> bool:
     """Probe device init in a SUBPROCESS: the axon TPU tunnel can hang
-    jax.devices() indefinitely; a hung probe must not hang the bench."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    jax.devices() indefinitely; a hung probe must not hang the bench.
+
+    The tunnel also flaps — retry a few times (with a pause) before
+    concluding the chip is gone, so a transient outage doesn't turn the
+    round's perf artifact into a CPU number.
+    """
+    for attempt in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            if r.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt + 1 < attempts:
+            print(
+                f"WARNING: device probe {attempt + 1}/{attempts} failed; retrying",
+                file=sys.stderr,
+            )
+            time.sleep(60)
+    return False
 
 
 def main() -> None:

@@ -233,6 +233,7 @@ int skip_value(Cursor& c, double* num) {
     // surfacing the error the Python path raises.
     if (ch == '-' || (ch >= '0' && ch <= '9')) {
         const char* s = c.p;
+        bool is_int = true;
         if (*s == '-') ++s;
         if (s >= c.end || *s < '0' || *s > '9') return 0;
         if (*s == '0') {
@@ -241,11 +242,13 @@ int skip_value(Cursor& c, double* num) {
             while (s < c.end && *s >= '0' && *s <= '9') ++s;
         }
         if (s < c.end && *s == '.') {
+            is_int = false;
             ++s;
             if (s >= c.end || *s < '0' || *s > '9') return 0;
             while (s < c.end && *s >= '0' && *s <= '9') ++s;
         }
         if (s < c.end && (*s == 'e' || *s == 'E')) {
+            is_int = false;
             ++s;
             if (s < c.end && (*s == '+' || *s == '-')) ++s;
             if (s >= c.end || *s < '0' || *s > '9') return 0;
@@ -259,6 +262,11 @@ int skip_value(Cursor& c, double* num) {
         char* endp = nullptr;
         *num = strtod_l(token.c_str(), &endp, c_locale);
         if (endp != token.c_str() + token.size()) return 0;
+        // an INTEGER literal overflowing double: json.loads gives a Python
+        // int and float(int) raises OverflowError on the Python path —
+        // decline rather than silently serving inf. (Float literals like
+        // 1e999 become inf in BOTH paths, so those stay.)
+        if (is_int && !std::isfinite(*num)) return 0;
         c.p = s;
         return 1;
     }
@@ -287,6 +295,11 @@ void* pio_props_scan(const char* buf, const int64_t* offsets, int64_t nrows) {
         }
         if (c.peek('}')) {
             ++c.p;
+            c.ws();
+            if (c.p != c.end) {  // '{}garbage' is a json.loads error
+                delete scan;
+                return nullptr;
+            }
             continue;
         }
         while (true) {

@@ -41,18 +41,29 @@ _lib_lock = threading.Lock()
 
 
 def _build() -> bool:
-    """Compile the kernel library; True on success."""
+    """Compile the kernel library; True on success.
+
+    Compiles to a per-process temp name and os.replace()s into place —
+    concurrent first-use processes (multi-process scale-out is a supported
+    topology) must never dlopen a half-written file.
+    """
     gxx = os.environ.get("CXX") or "g++"
+    tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            [gxx, "-O3", "-Wall", "-shared", "-fPIC", "-o", _SO_PATH, _SRC_PATH],
+            [gxx, "-O3", "-Wall", "-shared", "-fPIC", "-o", tmp, _SRC_PATH],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, _SO_PATH)
         return True
     except (OSError, subprocess.SubprocessError) as e:
         logger.info("native kernel build unavailable (%s); using Python paths", e)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -269,6 +269,56 @@ def _flash_2d_vjp(causal, scale, block_q, block_k, interpret, res, do):
 _flash_2d.defvjp(_flash_2d_fwd, _flash_2d_vjp)
 
 
+def flash_block_fwd(
+    q, k, v, causal: bool, scale: float, block_q: int, block_k: int,
+    interpret: bool,
+):
+    """One block-pair forward returning (o, lse); q/k/v: (..., T, D).
+
+    ``o`` is the softmax-normalized attention of q over THIS k/v block and
+    ``lse`` (..., T) its log-sum-exp — the pair composes across blocks via
+    ``logaddexp`` merging, which is how ring attention stitches a global
+    result out of per-block Pallas calls (parallel/ring.py).
+    """
+    fn = functools.partial(
+        _flash_2d_res,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    for _ in range(q.ndim - 2):
+        fn = jax.vmap(fn)
+    o, lse = fn(q, k, v)
+    return o, lse[..., 0]
+
+
+def flash_block_bwd(
+    q, k, v, o, lse, do, causal: bool, scale: float, block_q: int,
+    block_k: int, interpret: bool,
+):
+    """One block-pair backward: (dq, dk, dv) contributions.
+
+    ``o`` and ``lse`` are the GLOBAL (all-blocks) forward results for these
+    queries — with a global lse, ``exp(s - lse)`` inside the kernels is the
+    globally-normalized probability of this block, so the returned pieces
+    are exactly this block's share of the full gradients (ring backward).
+    ``lse``: (..., T).
+    """
+    fn = functools.partial(
+        _flash_2d_bwd,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    for _ in range(q.ndim - 2):
+        fn = jax.vmap(fn)
+    return fn(q, k, v, o, lse[..., None], do)
+
+
 def flash_attention(
     q,
     k,

@@ -17,6 +17,13 @@ no T×T materialization, O(T_local) memory per device.
 ``_ring_attention_block`` is the per-device kernel, usable inside other
 shard_mapped programs.  Causal masking uses global block offsets so the
 result equals single-device causal attention exactly.
+
+``ring_flash_attention`` is the same contract with the Pallas flash kernel
+inside each ring step (no (T_local, T_local) score tile is ever
+materialized) and a hand-written ring VJP: the forward saves the global
+log-sum-exp, and the backward circulates k/v (with their dk/dv
+accumulators) around the ring once more, each device adding its block's
+exact gradient share — the configuration for genuinely long contexts.
 """
 
 from __future__ import annotations
@@ -121,6 +128,228 @@ def _build_ring_fn(mesh, axis: str, n_blocks: int, causal: bool,
     return jax.jit(
         shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     )
+
+
+# -- ring + Pallas flash blocks: the production long-context configuration --
+#
+# _ring_attention_block above materializes a (T_local, T_local) score tile
+# per ring step; for long local blocks that tile is the VMEM/HBM hot spot.
+# The flash composition below never materializes it: each ring step runs the
+# Pallas flash kernel on the (q_local, k_blk) pair and merges the
+# (o, logsumexp) pair across steps — mathematically the same online softmax,
+# tiled on the MXU. The backward is the standard ring backward: with the
+# GLOBAL lse saved from the forward, each block's Pallas backward yields
+# exactly its share of dq/dk/dv; dk/dv accumulators travel around the ring
+# with their k/v blocks and arrive home after n steps.
+
+
+def _ring_causal_switch(src, my_idx, full_fn, diag_fn, skip_fn):
+    """Dispatch a ring step by block relation: past=full, self=diag, future=skip."""
+    branch = jnp.where(src == my_idx, 1, jnp.where(src < my_idx, 0, 2))
+    return jax.lax.switch(branch, (full_fn, diag_fn, skip_fn), None)
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, n_blocks, causal, scale,
+                         block_q, block_k, interpret):
+    from predictionio_tpu.ops.flash_attention import flash_block_fwd
+
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n_blocks) for j in range(n_blocks)]
+
+    def step_fn(carry, step):
+        o, lse, k_blk, v_blk = carry
+        src = (my_idx - step) % n_blocks
+
+        def full(_):
+            return flash_block_fwd(
+                q, k_blk, v_blk, False, scale, block_q, block_k, interpret
+            )
+
+        def diag(_):
+            return flash_block_fwd(
+                q, k_blk, v_blk, True, scale, block_q, block_k, interpret
+            )
+
+        def skip(_):
+            return (
+                jnp.zeros_like(q),
+                jnp.full(q.shape[:-1], NEG_INF, jnp.float32),
+            )
+
+        if causal:
+            o_b, lse_b = _ring_causal_switch(src, my_idx, full, diag, skip)
+        else:
+            o_b, lse_b = full(None)
+        lse_new = jnp.logaddexp(lse, lse_b)
+        w_old = jnp.exp(lse - lse_new)
+        w_new = jnp.exp(lse_b - lse_new)
+        # accumulate in f32 whatever the input dtype (stable scan carry)
+        o = o * w_old[..., None] + o_b.astype(jnp.float32) * w_new[..., None]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o, lse_new, k_next, v_next), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    # no pcast here (unlike _ring_attention_block): this kernel runs under
+    # check_vma=False, where constants need no varying annotation
+    lse0 = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+    (o, lse, _, _), _ = jax.lax.scan(
+        step_fn, (o0, lse0, k, v), jnp.arange(n_blocks)
+    )
+    return o.astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _ring_flash(q, k, v, axis_name, n_blocks, causal, scale, block_q,
+                block_k, interpret):
+    o, _ = _ring_flash_fwd_impl(
+        q, k, v, axis_name, n_blocks, causal, scale, block_q, block_k,
+        interpret,
+    )
+    return o
+
+
+def _ring_flash_fwd(q, k, v, axis_name, n_blocks, causal, scale, block_q,
+                    block_k, interpret):
+    o, lse = _ring_flash_fwd_impl(
+        q, k, v, axis_name, n_blocks, causal, scale, block_q, block_k,
+        interpret,
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd(axis_name, n_blocks, causal, scale, block_q, block_k,
+                    interpret, res, do):
+    from predictionio_tpu.ops.flash_attention import flash_block_bwd
+
+    q, k, v, o, lse = res
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n_blocks) for j in range(n_blocks)]
+
+    def step_fn(carry, step):
+        dq, k_blk, v_blk, dk_blk, dv_blk = carry
+        src = (my_idx - step) % n_blocks
+
+        def full(_):
+            return flash_block_bwd(
+                q, k_blk, v_blk, o, lse, do, False, scale, block_q, block_k,
+                interpret,
+            )
+
+        def diag(_):
+            return flash_block_bwd(
+                q, k_blk, v_blk, o, lse, do, True, scale, block_q, block_k,
+                interpret,
+            )
+
+        def skip(_):
+            return (
+                jnp.zeros_like(q),
+                jnp.zeros_like(k_blk),
+                jnp.zeros_like(v_blk),
+            )
+
+        if causal:
+            dq_c, dk_c, dv_c = _ring_causal_switch(
+                src, my_idx, full, diag, skip
+            )
+        else:
+            dq_c, dk_c, dv_c = full(None)
+        dq = dq + dq_c
+        dk_blk = dk_blk + dk_c
+        dv_blk = dv_blk + dv_c
+        # dk/dv ride the ring WITH their k/v block: after n steps each
+        # block's accumulated gradient is back at its owner
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_next = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_next = jax.lax.ppermute(dv_blk, axis_name, perm)
+        return (dq, k_next, v_next, dk_next, dv_next), None
+
+    dq0 = jnp.zeros_like(q)
+    dk0 = jnp.zeros_like(k)
+    dv0 = jnp.zeros_like(v)
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step_fn, (dq0, k, v, dk0, dv0), jnp.arange(n_blocks)
+    )
+    return dq, dk, dv
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+@lru_cache(maxsize=64)
+def _build_ring_flash_fn(mesh, axis: str, n_blocks: int, causal: bool,
+                         scale: float, ndim: int, block_q: int, block_k: int,
+                         interpret: bool):
+    spec = P(*([None] * (ndim - 2) + [axis, None]))
+    kernel = partial(
+        _ring_flash,
+        axis_name=axis,
+        n_blocks=n_blocks,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    return jax.jit(
+        shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            # pallas_call out_shapes carry no vma annotation; the kernel's
+            # collectives are hand-placed, so skip the vma checker here
+            check_vma=False,
+        )
+    )
+
+
+def ring_flash_attention(
+    ctx: MeshContext,
+    q,
+    k,
+    v,
+    axis: str = "data",
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Exact attention, sequence-sharded over ``axis``, Pallas inside.
+
+    Same contract as :func:`ring_attention` (forward AND backward, via the
+    hand-written ring VJP) but each ring step runs the flash kernel instead
+    of materializing a (T_local, T_local) score tile — the configuration
+    for genuinely long contexts on TPU.
+    """
+    from predictionio_tpu.ops.flash_attention import BLOCK_K, BLOCK_Q
+
+    n_blocks = ctx.axis_size(axis)
+    t = q.shape[-2]
+    if t % n_blocks:
+        raise ValueError(f"sequence length {t} not divisible by {n_blocks} shards")
+    t_local = t // n_blocks
+    bq = min(block_q or BLOCK_Q, t_local)
+    bk = min(block_k or BLOCK_K, t_local)
+    if t_local % bq or t_local % bk:
+        raise ValueError(
+            f"local block length {t_local} must divide flash blocks ({bq}, {bk})"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    ndim = q.ndim
+    spec = P(*([None] * (ndim - 2) + [axis, None]))
+    sharding = ctx.sharding(*spec)
+    q, k, v = (jax.device_put(jnp.asarray(x), sharding) for x in (q, k, v))
+    fn = _build_ring_flash_fn(
+        ctx.mesh, axis, n_blocks, causal, scale, ndim, bq, bk, interpret
+    )
+    return fn(q, k, v)
 
 
 def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):

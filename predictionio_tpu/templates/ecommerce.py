@@ -229,21 +229,41 @@ class ECommAlgorithm(Algorithm):
         cats = set(query.categories) if query.categories else None
 
         inv = item_map.inverse
-        results = []
-        for idx in np.argsort(-scores):
-            item_id = inv[int(idx)]
+
+        def accept(idx: int) -> Optional[ItemScore]:
+            item_id = inv[idx]
             if item_id in excluded:
-                continue
+                return None
             if white is not None and item_id not in white:
-                continue
+                return None
             if cats is not None and not (
                 model.item_categories.get(item_id, set()) & cats
             ):
-                continue
-            results.append(ItemScore(item_id, float(scores[idx])))
-            if len(results) >= query.num:
-                break
-        return PredictedResult(itemScores=results)
+                return None
+            return ItemScore(item_id, float(scores[idx]))
+
+        # top-m argpartition, widening ×4 while filters reject candidates —
+        # a full catalog argsort is O(n log n) and at UR catalog scale the
+        # sort (not the scoring) dominates per-query latency. Each pass
+        # rescans its own sorted prefix (ties may order differently between
+        # partitions, so passes don't share state).
+        n = len(scores)
+        if n == 0:
+            return PredictedResult(itemScores=[])
+        m = min(max(query.num * 4, 16), n)
+        while True:
+            top = np.argpartition(-scores, m - 1)[:m]
+            top = top[np.argsort(-scores[top])]
+            results = []
+            for idx in top:
+                s = accept(int(idx))
+                if s is not None:
+                    results.append(s)
+                    if len(results) >= query.num:
+                        return PredictedResult(itemScores=results)
+            if m >= n:
+                return PredictedResult(itemScores=results)
+            m = min(m * 4, n)
 
 
 class ECommerceEngine(EngineFactory):

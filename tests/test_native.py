@@ -284,3 +284,20 @@ def test_throughput_info(lib):
         f"python: {len(rows)/t_python/1e6:.2f}M rows/s, "
         f"speedup {t_python/t_native:.1f}x"
     )
+
+
+def test_trailing_garbage_after_empty_object_declines(lib):
+    assert (
+        native.scan_numeric_props(np.array(["{}x", '{"a":1}'], object)) is None
+    )
+
+
+def test_overflowing_int_literal_declines(lib):
+    # json.loads gives a Python int; float(int) raises OverflowError on the
+    # Python path — the kernel must not silently serve inf
+    big = '{"a": %d}' % (10**400)
+    assert native.scan_numeric_props(np.array([big], object)) is None
+    # float literals that overflow become inf in BOTH paths and stay native
+    got = native.scan_numeric_props(np.array(['{"a": 1e999}'], object))
+    assert got is not None and np.isposinf(got["a"][0])
+    assert python_reference(['{"a": 1e999}'])["a"][0] == np.inf
