@@ -255,9 +255,12 @@ def _ring_flash_bwd(axis_name, n_blocks, causal, scale, block_q, block_k,
             )
         else:
             dq_c, dk_c, dv_c = full(None)
-        dq = dq + dq_c
-        dk_blk = dk_blk + dk_c
-        dv_blk = dv_blk + dv_c
+        # f32 accumulation whatever the input dtype (same stable-carry rule
+        # as the forward's o): bf16 += per-block shares would round at every
+        # ring step
+        dq = dq + dq_c.astype(jnp.float32)
+        dk_blk = dk_blk + dk_c.astype(jnp.float32)
+        dv_blk = dv_blk + dv_c.astype(jnp.float32)
         # dk/dv ride the ring WITH their k/v block: after n steps each
         # block's accumulated gradient is back at its owner
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
@@ -266,13 +269,13 @@ def _ring_flash_bwd(axis_name, n_blocks, causal, scale, block_q, block_k,
         dv_next = jax.lax.ppermute(dv_blk, axis_name, perm)
         return (dq, k_next, v_next, dk_next, dv_next), None
 
-    dq0 = jnp.zeros_like(q)
-    dk0 = jnp.zeros_like(k)
-    dv0 = jnp.zeros_like(v)
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
     (dq, _, _, dk, dv), _ = jax.lax.scan(
         step_fn, (dq0, k, v, dk0, dv0), jnp.arange(n_blocks)
     )
-    return dq, dk, dv
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
@@ -336,7 +339,7 @@ def ring_flash_attention(
     bk = min(block_k or BLOCK_K, t_local)
     if t_local % bq or t_local % bk:
         raise ValueError(
-            f"local block length {t_local} must divide flash blocks ({bq}, {bk})"
+            f"flash block sizes ({bq}, {bk}) must divide local block length {t_local}"
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

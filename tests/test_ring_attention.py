@@ -105,6 +105,36 @@ class TestRingFlashAttention:
                 np.asarray(g), np.asarray(r), rtol=2e-4, atol=2e-5
             )
 
+    def test_bf16_gradients_accumulate_in_f32(self, ctx):
+        """bf16 inputs: the backward's ring carry is f32 (like the forward's
+        o), so grads track an f32-computed reference within bf16 resolution
+        and come back in the input dtype."""
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.parallel.ring import ring_flash_attention
+
+        rng = np.random.default_rng(9)
+        q, k, v = rand_qkv(rng, (2, 64, 8))
+
+        def ring_loss(q_, k_, v_):
+            return ring_flash_attention(ctx, q_, k_, v_, causal=True).sum()
+
+        def dense_loss(q_, k_, v_):
+            return full_attention(q_, k_, v_, causal=True).sum()
+
+        got = jax.grad(ring_loss, argnums=(0, 1, 2))(
+            *(jnp.asarray(x, jnp.bfloat16) for x in (q, k, v))
+        )
+        want = jax.grad(dense_loss, argnums=(0, 1, 2))(
+            *(jnp.asarray(x) for x in (q, k, v))
+        )
+        for g, r in zip(got, want):
+            assert g.dtype == jnp.bfloat16
+            np.testing.assert_allclose(
+                np.asarray(g, np.float32), np.asarray(r), rtol=0.1, atol=0.05
+            )
+
     def test_matches_dense_ring(self, ctx):
         """The two ring implementations agree with each other too."""
         from predictionio_tpu.parallel.ring import ring_flash_attention
