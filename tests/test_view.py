@@ -1,0 +1,196 @@
+"""SQL-queryable DataView (parity: data/view/DataView.scala)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+
+UTC = dt.timezone.utc
+T0 = dt.datetime(2026, 2, 1, tzinfo=UTC)
+
+
+def seed(storage, app_name="viewapp"):
+    from predictionio_tpu.data.storage.base import App
+
+    app_id = storage.get_meta_data_apps().insert(App(0, app_name))
+    le = storage.get_l_events()
+    le.init(app_id)
+    events = []
+    for u in range(4):
+        for i in range(u + 1):
+            events.append(
+                Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties={"rating": float(i + 1)},
+                      event_time=T0 + dt.timedelta(minutes=u))
+            )
+    events.append(Event(event="$set", entity_type="user", entity_id="u0",
+                        properties={"vip": True}, event_time=T0))
+    le.batch_insert(events, app_id)
+    return app_id
+
+
+@pytest.fixture()
+def bound_storage(storage):
+    from predictionio_tpu.data import store as store_mod
+
+    store_mod.set_storage(storage)
+    seed(storage)
+    yield storage
+    store_mod.set_storage(None)
+
+
+class TestCreate:
+    def test_default_flat_columns(self, bound_storage):
+        from predictionio_tpu.data import view
+
+        df = view.create("viewapp")
+        assert len(df) == 11  # 10 rates + 1 $set
+        assert {"event", "entityId", "targetEntityId", "properties",
+                "eventTime"} <= set(df.columns)
+
+    def test_conversion_drops_none(self, bound_storage):
+        from predictionio_tpu.data import view
+
+        df = view.create(
+            "viewapp",
+            conversion=lambda e: {"u": e.entity_id, "i": e.target_entity_id,
+                                  "r": e.properties.get("rating")}
+            if e.event == "rate" else None,
+        )
+        assert len(df) == 10
+        assert list(df.columns) == ["u", "i", "r"]
+        assert df["r"].sum() == sum(i + 1 for u in range(4) for i in range(u + 1))
+
+    def test_time_window(self, bound_storage):
+        from predictionio_tpu.data import view
+
+        df = view.create("viewapp", start_time=T0 + dt.timedelta(minutes=2))
+        assert set(df["entityId"]) == {"u2", "u3"}
+
+    def test_cache_roundtrip(self, bound_storage, tmp_path, monkeypatch):
+        pytest.importorskip("pyarrow")
+        from predictionio_tpu.data import view
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        until = T0 + dt.timedelta(hours=1)
+        conv = lambda e: {"e": e.event}  # noqa: E731
+        df1 = view.create("viewapp", until_time=until, conversion=conv)
+        cached = list((tmp_path / "view").glob("*.parquet"))
+        assert len(cached) == 1
+        # second call must come from the cache: nuke the store binding
+        from predictionio_tpu.data import store as store_mod
+
+        store_mod.set_storage(None)
+        try:
+            df2 = view.create("viewapp", until_time=until, conversion=conv)
+        finally:
+            store_mod.set_storage(bound_storage)
+        assert df1.equals(df2)
+
+    def test_unbounded_view_not_cached(self, bound_storage, tmp_path, monkeypatch):
+        from predictionio_tpu.data import view
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        view.create("viewapp")
+        assert not (tmp_path / "view").exists()
+
+    def test_open_future_window_not_cached(self, bound_storage, tmp_path,
+                                           monkeypatch):
+        """A future until_time still admits new events — must not freeze."""
+        from predictionio_tpu.data import view
+        from predictionio_tpu.data.event import utcnow
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        view.create("viewapp", until_time=utcnow() + dt.timedelta(days=1))
+        assert not (tmp_path / "view").exists()
+
+    def test_conversion_hash_sees_attribute_names(self):
+        from predictionio_tpu.data.view import _conversion_hash
+
+        a = _conversion_hash(lambda e: {"u": e.entity_id})
+        b = _conversion_hash(lambda e: {"u": e.target_entity_id})
+        assert a != b
+
+    def test_empty_app_default_view_has_columns(self, storage):
+        from predictionio_tpu.data import store as store_mod
+        from predictionio_tpu.data import view
+        from predictionio_tpu.data.storage.base import App
+
+        store_mod.set_storage(storage)
+        try:
+            storage.get_meta_data_apps().insert(App(0, "emptyapp"))
+            out = view.events_sql(
+                "emptyapp", "SELECT COUNT(*) AS n FROM events")
+            assert list(out["n"]) == [0]
+        finally:
+            store_mod.set_storage(None)
+
+
+class TestSql:
+    def test_sql_over_views(self, bound_storage):
+        from predictionio_tpu.data import view
+
+        rates = view.create(
+            "viewapp",
+            conversion=lambda e: {"u": e.entity_id, "i": e.target_entity_id}
+            if e.event == "rate" else None,
+        )
+        out = view.sql(
+            "SELECT u, COUNT(*) AS n FROM rates GROUP BY u ORDER BY n DESC",
+            rates=rates,
+        )
+        assert list(out["n"]) == [4, 3, 2, 1]
+        assert out["u"][0] == "u3"
+
+    def test_sql_join_two_views(self, bound_storage):
+        import pandas as pd
+
+        from predictionio_tpu.data import view
+
+        rates = view.create(
+            "viewapp",
+            conversion=lambda e: {"i": e.target_entity_id}
+            if e.event == "rate" else None,
+        )
+        names = pd.DataFrame({"i": ["i0", "i1"], "title": ["zero", "one"]})
+        out = view.sql(
+            "SELECT title, COUNT(*) AS n FROM rates JOIN names USING (i) "
+            "GROUP BY title ORDER BY title",
+            rates=rates, names=names,
+        )
+        assert list(out["title"]) == ["one", "zero"]
+        assert list(out["n"]) == [3, 4]
+
+    def test_sql_requires_views(self):
+        from predictionio_tpu.data import view
+
+        with pytest.raises(ValueError):
+            view.sql("SELECT 1")
+
+    def test_sql_rejects_bare_dataframe_as_views(self):
+        import pandas as pd
+
+        from predictionio_tpu.data import view
+
+        with pytest.raises(TypeError, match="views"):
+            view.sql("SELECT * FROM views", pd.DataFrame({"x": [1]}))
+
+    def test_sql_rejects_column_less_view(self):
+        import pandas as pd
+
+        from predictionio_tpu.data import view
+
+        with pytest.raises(ValueError, match="no columns"):
+            view.sql("SELECT * FROM t", t=pd.DataFrame())
+
+    def test_events_sql_one_shot(self, bound_storage):
+        from predictionio_tpu.data import view
+
+        out = view.events_sql(
+            "viewapp",
+            "SELECT event, COUNT(*) AS n FROM events GROUP BY event ORDER BY event",
+        )
+        assert list(out["event"]) == ["$set", "rate"]
+        assert list(out["n"]) == [1, 10]
