@@ -11,6 +11,12 @@ over the mesh ``data`` axis (pure DP — gradients all-reduced by XLA); the
 attention is the same causal kernel ring attention provides, so sequence
 parallelism over a ``seq`` mesh axis composes when histories outgrow a chip
 (``parallel/ring.py``).  Optimizer: optax adam.
+
+Expert parallelism: with ``n_experts > 0`` the FFN becomes a Switch-style
+top-1 mixture of experts whose weights (and adam moments) shard over the
+mesh ``model`` axis; the einsum dispatch keeps the expert dim leading so
+GSPMD partitions per-expert matmuls across devices and inserts the token
+exchange collectives.
 """
 
 from __future__ import annotations
@@ -26,7 +32,12 @@ import optax
 
 from predictionio_tpu.data.batch import Interactions
 from predictionio_tpu.data.bimap import BiMap
-from predictionio_tpu.parallel.mesh import DATA_AXIS, MeshContext, pad_to_multiple
+from predictionio_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshContext,
+    pad_to_multiple,
+)
 from predictionio_tpu.parallel.ring import full_attention
 
 PAD = 0  # item ids are shifted by +1; 0 is the padding token
@@ -42,6 +53,13 @@ class SASRecConfig:
     batch_size: int = 128
     lr: float = 1e-2
     seed: int = 0
+    # Mixture-of-experts FFN (0 = dense). Experts are sharded over the mesh
+    # `model` axis when one exists (expert parallelism): Switch-style top-1
+    # routing with a static per-expert capacity; overflow tokens ride the
+    # residual connection.
+    n_experts: int = 0
+    expert_capacity: float = 1.25  # capacity factor × (tokens / n_experts)
+    moe_aux_weight: float = 0.01  # Switch load-balancing loss weight
 
 
 @dataclasses.dataclass
@@ -91,7 +109,7 @@ def build_sequences(
 
 
 def _init_params(key, cfg: SASRecConfig, n_items: int) -> dict:
-    keys = jax.random.split(key, 2 + cfg.n_layers * 4)
+    keys = jax.random.split(key, 2 + cfg.n_layers * 5)
     d = cfg.d_model
     params = {
         "emb": jax.random.normal(keys[0], (n_items + 1, d)) * 0.02,
@@ -99,17 +117,26 @@ def _init_params(key, cfg: SASRecConfig, n_items: int) -> dict:
         "layers": [],
     }
     for i in range(cfg.n_layers):
-        k0, k1, k2, k3 = keys[2 + i * 4 : 6 + i * 4]
-        params["layers"].append(
-            {
-                "wqkv": jax.random.normal(k0, (d, 3 * d)) * (d**-0.5),
-                "wo": jax.random.normal(k1, (d, d)) * (d**-0.5),
-                "w1": jax.random.normal(k2, (d, 4 * d)) * (d**-0.5),
-                "w2": jax.random.normal(k3, (4 * d, d)) * ((4 * d) ** -0.5),
-                "ln1": jnp.ones(d),
-                "ln2": jnp.ones(d),
-            }
-        )
+        k0, k1, k2, k3, k4 = keys[2 + i * 5 : 7 + i * 5]
+        layer = {
+            "wqkv": jax.random.normal(k0, (d, 3 * d)) * (d**-0.5),
+            "wo": jax.random.normal(k1, (d, d)) * (d**-0.5),
+            "ln1": jnp.ones(d),
+            "ln2": jnp.ones(d),
+        }
+        if cfg.n_experts:
+            e = cfg.n_experts
+            layer["router"] = jax.random.normal(k4, (d, e)) * (d**-0.5)
+            layer["w1"] = jax.random.normal(k2, (e, d, 4 * d)) * (d**-0.5)
+            layer["w2"] = (
+                jax.random.normal(k3, (e, 4 * d, d)) * ((4 * d) ** -0.5)
+            )
+        else:
+            layer["w1"] = jax.random.normal(k2, (d, 4 * d)) * (d**-0.5)
+            layer["w2"] = (
+                jax.random.normal(k3, (4 * d, d)) * ((4 * d) ** -0.5)
+            )
+        params["layers"].append(layer)
     return params
 
 
@@ -125,8 +152,60 @@ def _layer_norm(x, g):
     return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g
 
 
+def _moe_ffn(layer, y, cfg: SASRecConfig, valid=None):
+    """Switch-style top-1 mixture-of-experts FFN. y (B, T, D) → (out, aux).
+
+    Static shapes throughout (jit-friendly).  Dispatch is per batch row
+    (the routing "group"): each (row, expert) pair has a fixed capacity of
+    ``expert_capacity · T / E`` slots, so the one-hot dispatch tensor is
+    O(tokens · capacity_per_row) — linear in token count, not the O(N²) a
+    flat global dispatch would cost.  The expert dimension stays leading on
+    the expert weights, so with w1/w2 sharded over the mesh ``model`` axis
+    XLA partitions the per-expert matmuls across devices (expert
+    parallelism) and inserts the token exchange collectives itself.
+    Overflow tokens get a zero FFN delta — the residual carries them.
+
+    ``valid`` (B, T) masks PAD positions out of routing entirely: pads
+    neither consume expert capacity nor enter the load-balancing statistics.
+    ``aux`` is the Switch loss E·Σ_e f_e·P_e over REAL tokens (≈1 when
+    balanced).
+    """
+    b, t, d = y.shape
+    e = cfg.n_experts
+    cap = max(1, int(cfg.expert_capacity * t / e))
+    probs = jax.nn.softmax(y @ layer["router"], axis=-1)  # (B, T, E)
+    gate = probs.max(-1)
+    expert = probs.argmax(-1)
+    onehot = jax.nn.one_hot(expert, e, dtype=y.dtype)  # (B, T, E)
+    if valid is not None:
+        onehot = onehot * valid[..., None].astype(y.dtype)
+    # token's position in its (row, expert) queue; >= cap drops the token
+    pos = (jnp.cumsum(onehot, axis=1) * onehot).sum(-1) - 1.0  # (B, T)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=y.dtype)
+    keep = (pos < cap).astype(y.dtype)
+    dispatch = (
+        onehot[..., None] * slot[..., None, :] * keep[..., None, None]
+    )  # (B, T, E, C)
+    xs = jnp.einsum("btd,btec->becd", y, dispatch)  # (B, E, C, D)
+    h = jax.nn.relu(jnp.einsum("becd,edf->becf", xs, layer["w1"]))
+    out = jnp.einsum("becf,efd->becd", h, layer["w2"])
+    yout = jnp.einsum("becd,btec->btd", out, dispatch) * gate[..., None]
+    # load-balance statistics over real tokens only
+    if valid is None:
+        n_real = jnp.asarray(b * t, y.dtype)
+        probs_real = probs
+    else:
+        vmask = valid[..., None].astype(y.dtype)
+        n_real = jnp.maximum(vmask.sum(), 1.0)
+        probs_real = probs * vmask
+    f = onehot.sum((0, 1)) / n_real
+    p = probs_real.sum((0, 1)) / n_real
+    aux = e * jnp.sum(f * p)
+    return yout, aux
+
+
 def _forward(params, seq, cfg: SASRecConfig, allow_flash: bool = False):
-    """seq (B, T) int32 → hidden states (B, T, D).
+    """seq (B, T) int32 → (hidden states (B, T, D), MoE aux loss).
 
     allow_flash enables the Pallas flash kernel for long blocks on TPU —
     training included: the kernel carries a custom VJP (recomputation-form
@@ -135,6 +214,7 @@ def _forward(params, seq, cfg: SASRecConfig, allow_flash: bool = False):
     x = params["emb"][seq] + params["pos"][None, :, :]
     pad_mask = (seq == PAD)[:, :, None]
     h = cfg.d_model // cfg.n_heads
+    aux_total = jnp.zeros((), x.dtype)
     for layer in params["layers"]:
         y = _layer_norm(x, layer["ln1"])
         qkv = y @ layer["wqkv"]  # (B, T, 3D)
@@ -154,9 +234,14 @@ def _forward(params, seq, cfg: SASRecConfig, allow_flash: bool = False):
         a = a.swapaxes(-3, -2).reshape(*y.shape)
         x = x + a @ layer["wo"]
         y = _layer_norm(x, layer["ln2"])
-        x = x + jax.nn.relu(y @ layer["w1"]) @ layer["w2"]
+        if cfg.n_experts:
+            delta, aux = _moe_ffn(layer, y, cfg, valid=(seq != PAD))
+            x = x + delta
+            aux_total = aux_total + aux
+        else:
+            x = x + jax.nn.relu(y @ layer["w1"]) @ layer["w2"]
         x = jnp.where(pad_mask, 0.0, x)
-    return x
+    return x, aux_total
 
 
 def _loss_fn(params, seq, cfg: SASRecConfig):
@@ -166,19 +251,35 @@ def _loss_fn(params, seq, cfg: SASRecConfig):
     targets = seq[:, 1:]
     # flash path is differentiable (custom VJP); the gate inside _forward
     # still keeps short blocks / CPU on dense attention
-    hidden = _forward(params, inputs, cfg, allow_flash=True)  # uses pos[0:T-1]
+    hidden, aux = _forward(params, inputs, cfg, allow_flash=True)  # pos[0:T-1]
     logits = hidden @ params["emb"][1:].T  # (B, T-1, n_items); skip pad row
     mask = (targets != PAD) & (inputs != PAD)
     logp = jax.nn.log_softmax(logits, axis=-1)
     tgt = jnp.maximum(targets - 1, 0)  # back to 0-based item index
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    task = (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return task + cfg.moe_aux_weight * aux
 
 
 @partial(jax.jit, static_argnums=(2,))
 def _predict_logits(params, seq, cfg: SASRecConfig):
-    hidden = _forward(params, seq, cfg, allow_flash=True)
+    hidden, _ = _forward(params, seq, cfg, allow_flash=True)
     return hidden[:, -1, :] @ params["emb"][1:].T
+
+
+def _param_shardings(ctx: MeshContext, params: dict, cfg: SASRecConfig):
+    """Placement pytree: everything replicated except expert weights, which
+    shard over the mesh ``model`` axis (expert parallelism) when one exists
+    and evenly divides ``n_experts``."""
+    rep = ctx.replicated()
+    tree = jax.tree.map(lambda _: rep, params)
+    ep_ways = ctx.axis_size(MODEL_AXIS)
+    if cfg.n_experts and ep_ways > 1 and cfg.n_experts % ep_ways == 0:
+        ep = ctx.sharding(MODEL_AXIS, None, None)
+        for layer in tree["layers"]:
+            layer["w1"] = ep
+            layer["w2"] = ep
+    return tree
 
 
 def train_sasrec(
@@ -204,9 +305,11 @@ def train_sasrec(
 
     key = jax.random.PRNGKey(cfg.seed)
     params = _init_params(key, cfg, n_items)
-    params = jax.device_put(params, ctx.replicated())
+    params = jax.device_put(params, _param_shardings(ctx, params, cfg))
     opt = optax.adam(cfg.lr)
-    opt_state = jax.device_put(opt.init(params), ctx.replicated())
+    # zeros_like inherits each param's placement, so adam moments are
+    # expert-sharded exactly where the weights are
+    opt_state = opt.init(params)
     batch_sharding = ctx.sharding(DATA_AXIS, None)
 
     @partial(jax.jit, static_argnums=(3,), donate_argnums=(0, 1))

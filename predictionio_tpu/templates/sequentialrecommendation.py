@@ -92,6 +92,10 @@ class SASRecParams(Params):
     batchSize: int = 128
     lr: float = 0.005
     seed: int = 0
+    # mixture-of-experts FFN; experts shard over the mesh `model` axis (EP)
+    numExperts: int = 0
+    expertCapacity: float = 1.25
+    moeAuxWeight: float = 0.01
 
 
 class SASRecAlgorithm(Algorithm):
@@ -111,6 +115,9 @@ class SASRecAlgorithm(Algorithm):
                 batch_size=p.batchSize,
                 lr=p.lr,
                 seed=p.seed,
+                n_experts=p.numExperts,
+                expert_capacity=p.expertCapacity,
+                moe_aux_weight=p.moeAuxWeight,
             ),
         )
 
