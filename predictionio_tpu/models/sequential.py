@@ -60,6 +60,10 @@ class SASRecConfig:
     n_experts: int = 0
     expert_capacity: float = 1.25  # capacity factor × (tokens / n_experts)
     moe_aux_weight: float = 0.01  # Switch load-balancing loss weight
+    # Sequence parallelism: shard the time dimension over the mesh `model`
+    # axis and run ring attention between the shards — the long-context
+    # training mode (histories that don't fit one chip's HBM).
+    seq_parallel: bool = False
 
 
 @dataclasses.dataclass
@@ -204,14 +208,15 @@ def _moe_ffn(layer, y, cfg: SASRecConfig, valid=None):
     return yout, aux
 
 
-def _forward(params, seq, cfg: SASRecConfig, allow_flash: bool = False):
-    """seq (B, T) int32 → (hidden states (B, T, D), MoE aux loss).
+def _block_stack(params, seq, cfg: SASRecConfig, pos, attention):
+    """The transformer body shared by the DP and SP paths.
 
-    allow_flash enables the Pallas flash kernel for long blocks on TPU —
-    training included: the kernel carries a custom VJP (recomputation-form
-    backward), so long-context training memory is O(T·D), not O(T²).
+    ``pos`` is the positional table for THESE positions (the SP path passes
+    its per-device slice); ``attention`` maps head-split (B, H, T, h)
+    q/k/v to the attention output — dense, Pallas flash, or the ring block,
+    chosen by the caller.  Returns (hidden, MoE aux loss).
     """
-    x = params["emb"][seq] + params["pos"][None, :, :]
+    x = params["emb"][seq] + pos[None, :, :]
     pad_mask = (seq == PAD)[:, :, None]
     h = cfg.d_model // cfg.n_heads
     aux_total = jnp.zeros((), x.dtype)
@@ -223,14 +228,7 @@ def _forward(params, seq, cfg: SASRecConfig, allow_flash: bool = False):
         def heads(z):  # (B, T, D) → (B, H, T, h)
             return z.reshape(*z.shape[:-1], cfg.n_heads, h).swapaxes(-3, -2)
 
-        t = seq.shape[-1]
-        if allow_flash and _use_flash(t):
-            # long blocks: Pallas flash kernel (streams K/V through VMEM)
-            from predictionio_tpu.ops.flash_attention import flash_attention
-
-            a = flash_attention(heads(q), heads(k), heads(v), causal=True)
-        else:
-            a = full_attention(heads(q), heads(k), heads(v), causal=True)
+        a = attention(heads(q), heads(k), heads(v))
         a = a.swapaxes(-3, -2).reshape(*y.shape)
         x = x + a @ layer["wo"]
         y = _layer_norm(x, layer["ln2"])
@@ -244,6 +242,34 @@ def _forward(params, seq, cfg: SASRecConfig, allow_flash: bool = False):
     return x, aux_total
 
 
+def _masked_nll_sums(params, hidden, inp, tgt):
+    """(Σ masked nll, Σ mask) — the caller divides (SP psums first)."""
+    logits = hidden @ params["emb"][1:].T  # skip the pad row
+    mask = (tgt != PAD) & (inp != PAD)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tgt0 = jnp.maximum(tgt - 1, 0)  # back to 0-based item index
+    nll = -jnp.take_along_axis(logp, tgt0[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum(), mask.sum()
+
+
+def _forward(params, seq, cfg: SASRecConfig, allow_flash: bool = False):
+    """seq (B, T) int32 → (hidden states (B, T, D), MoE aux loss).
+
+    allow_flash enables the Pallas flash kernel for long blocks on TPU —
+    training included: the kernel carries a custom VJP (recomputation-form
+    backward), so long-context training memory is O(T·D), not O(T²).
+    """
+    t = seq.shape[-1]
+    if allow_flash and _use_flash(t):
+        # long blocks: Pallas flash kernel (streams K/V through VMEM)
+        from predictionio_tpu.ops.flash_attention import flash_attention
+
+        attention = partial(flash_attention, causal=True)
+    else:
+        attention = partial(full_attention, causal=True)
+    return _block_stack(params, seq, cfg, params["pos"], attention)
+
+
 def _loss_fn(params, seq, cfg: SASRecConfig):
     """Causal next-item cross-entropy; positions whose TARGET is pad are
     masked out."""
@@ -252,12 +278,8 @@ def _loss_fn(params, seq, cfg: SASRecConfig):
     # flash path is differentiable (custom VJP); the gate inside _forward
     # still keeps short blocks / CPU on dense attention
     hidden, aux = _forward(params, inputs, cfg, allow_flash=True)  # pos[0:T-1]
-    logits = hidden @ params["emb"][1:].T  # (B, T-1, n_items); skip pad row
-    mask = (targets != PAD) & (inputs != PAD)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    tgt = jnp.maximum(targets - 1, 0)  # back to 0-based item index
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    task = (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    num, den = _masked_nll_sums(params, hidden, inputs, targets)
+    task = num / jnp.maximum(den, 1)
     return task + cfg.moe_aux_weight * aux
 
 
@@ -265,6 +287,55 @@ def _loss_fn(params, seq, cfg: SASRecConfig):
 def _predict_logits(params, seq, cfg: SASRecConfig):
     hidden, _ = _forward(params, seq, cfg, allow_flash=True)
     return hidden[:, -1, :] @ params["emb"][1:].T
+
+
+def _build_sp_loss(mesh, sp_ways: int, cfg: SASRecConfig):
+    """shard_map'd loss with the sequence dimension ring-sharded.
+
+    Batch shards over ``data``, time over ``model``; params stay replicated.
+    Inside each device's block everything is local except the attention —
+    ``_ring_attention_block`` circulates K/V over the ``model`` axis with
+    ppermute (``parallel/ring.py``) — and the final masked-mean reduction
+    (one two-axis psum).  The input/target shift happens GLOBALLY before
+    sharding (a one-token shift must not cross block boundaries), so the
+    caller passes ``inputs``/``targets`` separately.
+
+    Numerically identical to the data-parallel `_loss_fn` (tested); use it
+    when ``max_len`` at full replication would not fit HBM.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from predictionio_tpu.parallel.ring import _ring_attention_block
+
+    attention = partial(
+        _ring_attention_block,
+        axis_name=MODEL_AXIS,
+        n_blocks=sp_ways,
+        causal=True,
+    )
+
+    def local_loss(params, inp, tgt):
+        # inp/tgt: (B/data, T/model) local blocks
+        t_local = inp.shape[1]
+        my = jax.lax.axis_index(MODEL_AXIS)
+        pos = jax.lax.dynamic_slice_in_dim(
+            params["pos"], my * t_local, t_local, axis=0
+        )
+        hidden, _ = _block_stack(params, inp, cfg, pos, attention)
+        num, den = _masked_nll_sums(params, hidden, inp, tgt)
+        num = jax.lax.psum(num, (DATA_AXIS, MODEL_AXIS))
+        den = jax.lax.psum(den, (DATA_AXIS, MODEL_AXIS))
+        return num / jnp.maximum(den, 1)
+
+    bt = P(DATA_AXIS, MODEL_AXIS)
+    return shard_map(
+        local_loss,
+        mesh=mesh,
+        in_specs=(P(), bt, bt),
+        out_specs=P(),
+        check_vma=False,  # replicated-params grads come via psum transpose
+    )
 
 
 def _param_shardings(ctx: MeshContext, params: dict, cfg: SASRecConfig):
@@ -303,6 +374,26 @@ def train_sasrec(
     batch = min(cfg.batch_size, pad_to_multiple(n, n_shards))
     batch = pad_to_multiple(batch, n_shards)
 
+    sp_ways = ctx.axis_size(MODEL_AXIS) if cfg.seq_parallel else 1
+    if cfg.seq_parallel:
+        if cfg.n_experts:
+            raise ValueError(
+                "seq_parallel and n_experts both claim the `model` mesh "
+                "axis; enable one of SP/EP per training run"
+            )
+        if sp_ways < 2:
+            raise ValueError(
+                "seq_parallel needs a mesh `model` axis of size >= 2 to "
+                "shard the time dimension over (e.g. engine.json mesh: "
+                '{"mesh_axes": {"data": N, "model": M}}); silently training '
+                "replicated would defeat the flag's HBM purpose"
+            )
+        if cfg.max_len % sp_ways:
+            raise ValueError(
+                f"max_len {cfg.max_len} not divisible by the model-axis "
+                f"size {sp_ways} required for sequence parallelism"
+            )
+
     key = jax.random.PRNGKey(cfg.seed)
     params = _init_params(key, cfg, n_items)
     params = jax.device_put(params, _param_shardings(ctx, params, cfg))
@@ -310,6 +401,32 @@ def train_sasrec(
     # zeros_like inherits each param's placement, so adam moments are
     # expert-sharded exactly where the weights are
     opt_state = opt.init(params)
+
+    rng = np.random.default_rng(cfg.seed)
+    loss = None
+    if sp_ways > 1:
+        sp_loss = _build_sp_loss(ctx.mesh, sp_ways, cfg)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def sp_step(params, opt_state, inp, tgt):
+            loss, grads = jax.value_and_grad(sp_loss)(params, inp, tgt)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        bt_sharding = ctx.sharding(DATA_AXIS, MODEL_AXIS)
+        for _ in range(cfg.epochs):
+            picks = rng.integers(0, n, batch)
+            sb = seqs[picks]
+            # the one-token input/target shift happens globally, BEFORE the
+            # time dimension is sharded
+            inp = jax.device_put(jnp.asarray(sb[:, :-1]), bt_sharding)
+            tgt = jax.device_put(jnp.asarray(sb[:, 1:]), bt_sharding)
+            params, opt_state, loss = sp_step(params, opt_state, inp, tgt)
+        return SASRecModel(
+            params=ctx.to_host(params), item_map=interactions.item_map,
+            config=cfg,
+        )
+
     batch_sharding = ctx.sharding(DATA_AXIS, None)
 
     @partial(jax.jit, static_argnums=(3,), donate_argnums=(0, 1))
@@ -318,8 +435,6 @@ def train_sasrec(
         updates, opt_state = opt.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    rng = np.random.default_rng(cfg.seed)
-    loss = None
     for _ in range(cfg.epochs):
         picks = rng.integers(0, n, batch)
         sb = jax.device_put(jnp.asarray(seqs[picks]), batch_sharding)

@@ -120,9 +120,9 @@ def ulysses_attention(
     on_tpu = jax.default_backend() == "tpu"
     if use_flash is None:
         # the local per-head attention sees the FULL sequence after the
-        # all_to_all; the Pallas kernel needs T divisible by its block
-        # (same gate models/sequential._use_flash applies)
-        use_flash = on_tpu and t % min(128, t) == 0
+        # all_to_all; same policy as models/sequential._use_flash — long
+        # 128-aligned blocks take the Pallas kernel, short ones stay dense
+        use_flash = on_tpu and t >= 256 and t % 128 == 0
     if interpret is None:
         interpret = not on_tpu
     ndim = q.ndim

@@ -96,6 +96,8 @@ class SASRecParams(Params):
     numExperts: int = 0
     expertCapacity: float = 1.25
     moeAuxWeight: float = 0.01
+    # shard the time dimension over the mesh `model` axis (ring attention)
+    seqParallel: bool = False
 
 
 class SASRecAlgorithm(Algorithm):
@@ -118,6 +120,7 @@ class SASRecAlgorithm(Algorithm):
                 n_experts=p.numExperts,
                 expert_capacity=p.expertCapacity,
                 moe_aux_weight=p.moeAuxWeight,
+                seq_parallel=p.seqParallel,
             ),
         )
 
