@@ -16,11 +16,14 @@ step state" on top of the reference's three deploy-time persistence modes
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 from typing import Any, Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 _CHECKPOINTER = None
@@ -100,3 +103,35 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         return restore_pytree(self._step_dir(step), ctx=ctx, shardings=shardings)
+
+
+def resume_from(manager: CheckpointManager, fingerprint, max_step: int):
+    """The fingerprint-gated resume policy shared by the trainers.
+
+    Returns ``(start_step, host_state)`` for the LARGEST checkpoint step
+    <= ``max_step`` whose stored fingerprint matches, or ``(0, None)``.
+    Scanning past the global latest matters: a leftover step from a longer
+    run (e.g. step_20 when rerunning with 10 iterations) must not disable
+    resume from a valid earlier step, and a foreign/stale checkpoint is
+    skipped with a warning, never silently loaded.
+    """
+    want = np.asarray(fingerprint)
+    skipped_high = []
+    for step in sorted(manager.steps(), reverse=True):
+        if step > max_step:
+            skipped_high.append(step)
+            continue
+        state = manager.restore(step)  # host pytree
+        got = np.asarray(state.get("fingerprint"))
+        if got.shape == want.shape and np.allclose(got, want):
+            return step, state
+        logger.warning(
+            "checkpoint step %d under %s does not match this config/dataset; "
+            "ignoring", step, manager.directory,
+        )
+    if skipped_high:
+        logger.warning(
+            "checkpoint steps %s under %s exceed the requested %d; "
+            "starting fresh", skipped_high, manager.directory, max_step,
+        )
+    return 0, None

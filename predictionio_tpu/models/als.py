@@ -333,30 +333,12 @@ def train_als(
             ],
             dtype=np.float64,
         )
-        latest = manager.latest_step()
-        if latest is not None and latest <= cfg.iterations:
-            state = manager.restore(
-                latest,
-                ctx=ctx,
-                shardings={"U": sharding, "V": sharding, "fingerprint": None},
-            )
-            saved_fp = np.asarray(jax.device_get(state.get("fingerprint")))
-            if saved_fp.shape == fingerprint.shape and np.allclose(
-                saved_fp, fingerprint
-            ):
-                U, V = state["U"], state["V"]
-                start_iter = latest
-            else:
-                logger.warning(
-                    "checkpoint at %s does not match this config/dataset; "
-                    "starting fresh", cfg.checkpoint_dir,
-                )
-        elif latest is not None:
-            logger.warning(
-                "checkpoint step %d exceeds iterations=%d; starting fresh",
-                latest,
-                cfg.iterations,
-            )
+        from predictionio_tpu.core.checkpoint import resume_from
+
+        start_iter, state = resume_from(manager, fingerprint, cfg.iterations)
+        if state is not None:
+            U = jax.device_put(np.asarray(state["U"]), sharding)
+            V = jax.device_put(np.asarray(state["V"]), sharding)
 
     for it in range(start_iter, cfg.iterations):
         U, V = step(U, V, u_blocks, i_blocks)

@@ -22,6 +22,7 @@ exchange collectives.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import partial
 from typing import Optional
 
@@ -39,6 +40,8 @@ from predictionio_tpu.parallel.mesh import (
     pad_to_multiple,
 )
 from predictionio_tpu.parallel.ring import full_attention
+
+logger = logging.getLogger(__name__)
 
 PAD = 0  # item ids are shifted by +1; 0 is the padding token
 
@@ -64,6 +67,11 @@ class SASRecConfig:
     # axis and run ring attention between the shards — the long-context
     # training mode (histories that don't fit one chip's HBM).
     seq_parallel: bool = False
+    # Mid-training checkpoint/resume (orbax; same contract as ALSConfig):
+    # params + optimizer state saved every checkpoint_interval epochs under
+    # checkpoint_dir; a restart resumes from the latest matching checkpoint.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval: int = 10
 
 
 @dataclasses.dataclass
@@ -145,9 +153,11 @@ def _init_params(key, cfg: SASRecConfig, n_items: int) -> dict:
 
 
 def _use_flash(t: int) -> bool:
-    """Long blocks on TPU take the Pallas kernel; short blocks and CPU stay
-    dense (interpret-mode flash loses on CPU)."""
-    return t >= 256 and t % 128 == 0 and jax.default_backend() == "tpu"
+    """Delegates to the shared gate next to the kernel (ops/flash_attention);
+    kept as a module symbol so tests can monkeypatch the policy."""
+    from predictionio_tpu.ops.flash_attention import use_flash_default
+
+    return use_flash_default(t)
 
 
 def _layer_norm(x, g):
@@ -396,14 +406,13 @@ def train_sasrec(
 
     key = jax.random.PRNGKey(cfg.seed)
     params = _init_params(key, cfg, n_items)
-    params = jax.device_put(params, _param_shardings(ctx, params, cfg))
+    param_shardings = _param_shardings(ctx, params, cfg)
+    params = jax.device_put(params, param_shardings)
     opt = optax.adam(cfg.lr)
     # zeros_like inherits each param's placement, so adam moments are
     # expert-sharded exactly where the weights are
     opt_state = opt.init(params)
 
-    rng = np.random.default_rng(cfg.seed)
-    loss = None
     if sp_ways > 1:
         sp_loss = _build_sp_loss(ctx.mesh, sp_ways, cfg)
 
@@ -414,31 +423,92 @@ def train_sasrec(
             return optax.apply_updates(params, updates), opt_state, loss
 
         bt_sharding = ctx.sharding(DATA_AXIS, MODEL_AXIS)
-        for _ in range(cfg.epochs):
-            picks = rng.integers(0, n, batch)
-            sb = seqs[picks]
+
+        def run_step(params, opt_state, sb):
             # the one-token input/target shift happens globally, BEFORE the
             # time dimension is sharded
             inp = jax.device_put(jnp.asarray(sb[:, :-1]), bt_sharding)
             tgt = jax.device_put(jnp.asarray(sb[:, 1:]), bt_sharding)
-            params, opt_state, loss = sp_step(params, opt_state, inp, tgt)
-        return SASRecModel(
-            params=ctx.to_host(params), item_map=interactions.item_map,
-            config=cfg,
+            return sp_step(params, opt_state, inp, tgt)
+    else:
+        batch_sharding = ctx.sharding(DATA_AXIS, None)
+
+        @partial(jax.jit, static_argnums=(3,), donate_argnums=(0, 1))
+        def step(params, opt_state, seq, cfg):
+            loss, grads = jax.value_and_grad(_loss_fn)(params, seq, cfg)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        def run_step(params, opt_state, sb):
+            seq = jax.device_put(jnp.asarray(sb), batch_sharding)
+            return step(params, opt_state, seq, cfg)
+
+    # mid-training checkpoint/resume (orbax; same contract as ALS):
+    # fingerprint ties checkpoints to this config + dataset, a mismatch
+    # starts fresh rather than silently resuming foreign state
+    start_epoch = 0
+    manager = None
+    fingerprint = None
+    if cfg.checkpoint_dir:
+        if cfg.checkpoint_interval < 1:
+            raise ValueError(
+                f"checkpoint_interval must be >= 1, got {cfg.checkpoint_interval}"
+            )
+        from predictionio_tpu.core.checkpoint import (
+            CheckpointManager,
+            resume_from,
         )
 
-    batch_sharding = ctx.sharding(DATA_AXIS, None)
+        manager = CheckpointManager(cfg.checkpoint_dir)
+        fingerprint = np.array(
+            [
+                n_items, n, batch, cfg.d_model, cfg.n_layers, cfg.n_heads,
+                cfg.max_len, float(cfg.lr), cfg.seed, cfg.n_experts,
+                float(cfg.expert_capacity), float(cfg.moe_aux_weight),
+                int(cfg.seq_parallel), float(np.sum(seqs, dtype=np.float64)),
+            ],
+            dtype=np.float64,
+        )
+        start_epoch, restored = resume_from(manager, fingerprint, cfg.epochs)
+        if restored is not None:
+            from jax.sharding import NamedSharding
 
-    @partial(jax.jit, static_argnums=(3,), donate_argnums=(0, 1))
-    def step(params, opt_state, seq, cfg):
-        loss, grads = jax.value_and_grad(_loss_fn)(params, seq, cfg)
-        updates, opt_state = opt.update(grads, opt_state)
-        return optax.apply_updates(params, updates), opt_state, loss
+            def put_like(r, leaf):
+                # mesh-sharded moments keep their sharding; leaves optax
+                # created with default placement (adam's step count) go
+                # mesh-replicated — a committed single-device array would
+                # conflict with the mesh-spanning params inside jit
+                if isinstance(leaf.sharding, NamedSharding):
+                    return jax.device_put(np.asarray(r), leaf.sharding)
+                return ctx.replicate(np.asarray(r))
 
-    for _ in range(cfg.epochs):
+            params = jax.device_put(restored["params"], param_shardings)
+            leaves, treedef = jax.tree.flatten(opt_state)
+            opt_state = jax.tree.unflatten(
+                treedef,
+                [put_like(r, leaf) for r, leaf in zip(restored["opt"], leaves)],
+            )
+
+    rng = np.random.default_rng(cfg.seed)
+    for _ in range(start_epoch):  # resume: fast-forward the batch sampler
+        rng.integers(0, n, batch)
+
+    loss = None
+    for epoch in range(start_epoch, cfg.epochs):
         picks = rng.integers(0, n, batch)
-        sb = jax.device_put(jnp.asarray(seqs[picks]), batch_sharding)
-        params, opt_state, loss = step(params, opt_state, sb, cfg)
+        params, opt_state, loss = run_step(params, opt_state, seqs[picks])
+        if manager is not None and (
+            (epoch + 1) % cfg.checkpoint_interval == 0
+            or epoch + 1 == cfg.epochs
+        ):
+            manager.save(
+                epoch + 1,
+                {
+                    "params": params,
+                    "opt": jax.tree.leaves(opt_state),
+                    "fingerprint": fingerprint,
+                },
+            )
     return SASRecModel(
         params=ctx.to_host(params), item_map=interactions.item_map, config=cfg
     )

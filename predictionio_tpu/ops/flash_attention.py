@@ -41,6 +41,14 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
+def use_flash_default(t: int) -> bool:
+    """The one gate policy for 'should this sequence take the Pallas path':
+    long 128-aligned blocks on TPU; short blocks and CPU stay dense
+    (interpret-mode flash loses on CPU).  Shared by the sequential model
+    and Ulysses so the threshold cannot drift between call sites."""
+    return t >= 256 and t % BLOCK_Q == 0 and jax.default_backend() == "tpu"
+
+
 def _causal_mask(qi, ki, block_q, block_k):
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0

@@ -117,14 +117,14 @@ def ulysses_attention(
             f"n_heads {h} not divisible by axis size {n}: Ulysses shards "
             "heads — use ring attention for head counts below the mesh size"
         )
-    on_tpu = jax.default_backend() == "tpu"
     if use_flash is None:
         # the local per-head attention sees the FULL sequence after the
-        # all_to_all; same policy as models/sequential._use_flash — long
-        # 128-aligned blocks take the Pallas kernel, short ones stay dense
-        use_flash = on_tpu and t >= 256 and t % 128 == 0
+        # all_to_all; the shared gate lives next to the kernel
+        from predictionio_tpu.ops.flash_attention import use_flash_default
+
+        use_flash = use_flash_default(t)
     if interpret is None:
-        interpret = not on_tpu
+        interpret = jax.default_backend() != "tpu"
     ndim = q.ndim
     spec = P(*([None] * (ndim - 2) + [axis, None]))
     sharding = ctx.sharding(*spec)

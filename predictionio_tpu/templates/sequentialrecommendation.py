@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+from typing import Optional
 
 from predictionio_tpu.core import (
     Algorithm,
@@ -98,6 +99,9 @@ class SASRecParams(Params):
     moeAuxWeight: float = 0.01
     # shard the time dimension over the mesh `model` axis (ring attention)
     seqParallel: bool = False
+    # mid-training checkpoint/resume (reference knob: setCheckpointInterval)
+    checkpointDir: Optional[str] = None
+    checkpointInterval: int = 10
 
 
 class SASRecAlgorithm(Algorithm):
@@ -121,6 +125,8 @@ class SASRecAlgorithm(Algorithm):
                 expert_capacity=p.expertCapacity,
                 moe_aux_weight=p.moeAuxWeight,
                 seq_parallel=p.seqParallel,
+                checkpoint_dir=p.checkpointDir,
+                checkpoint_interval=p.checkpointInterval,
             ),
         )
 

@@ -235,6 +235,108 @@ class TestSeqParallel:
             )
 
 
+class TestCheckpointResume:
+    """Mid-training checkpoint/resume (same contract as ALS)."""
+
+    def test_resume_matches_uninterrupted(self, ctx, tmp_path):
+        from predictionio_tpu.core.checkpoint import CheckpointManager
+
+        inter = cyclic_interactions()
+        base = dict(d_model=16, n_heads=2, n_layers=1, max_len=8,
+                    batch_size=16, seed=3)
+        full = train_sasrec(ctx, inter, SASRecConfig(epochs=6, **base))
+        ck = str(tmp_path / "sasrec")
+        train_sasrec(
+            ctx, inter,
+            SASRecConfig(epochs=3, checkpoint_dir=ck, checkpoint_interval=3,
+                         **base),
+        )
+        m = CheckpointManager(ck)
+        assert m.latest_step() == 3
+        resumed = train_sasrec(
+            ctx, inter,
+            SASRecConfig(epochs=6, checkpoint_dir=ck, checkpoint_interval=3,
+                         **base),
+        )
+        np.testing.assert_allclose(
+            resumed.params["emb"], full.params["emb"], rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            resumed.params["layers"][0]["wqkv"],
+            full.params["layers"][0]["wqkv"], rtol=1e-5, atol=1e-6,
+        )
+        assert m.latest_step() == 6
+
+    def test_foreign_checkpoint_ignored(self, ctx, tmp_path):
+        """A checkpoint from a different config/dataset starts fresh."""
+        inter = cyclic_interactions()
+        ck = str(tmp_path / "sasrec2")
+        base = dict(d_model=16, n_heads=2, n_layers=1, max_len=8,
+                    batch_size=16)
+        train_sasrec(
+            ctx, inter,
+            SASRecConfig(epochs=2, seed=1, checkpoint_dir=ck,
+                         checkpoint_interval=2, **base),
+        )
+        fresh = train_sasrec(ctx, inter, SASRecConfig(epochs=2, seed=9, **base))
+        # same dir, different seed → fingerprint mismatch → fresh run
+        redone = train_sasrec(
+            ctx, inter,
+            SASRecConfig(epochs=2, seed=9, checkpoint_dir=ck,
+                         checkpoint_interval=2, **base),
+        )
+        np.testing.assert_allclose(
+            redone.params["emb"], fresh.params["emb"], rtol=1e-5, atol=1e-6
+        )
+
+    def test_shorter_rerun_resumes_from_valid_older_step(self, ctx, tmp_path):
+        """A leftover step beyond the requested epochs must not disable
+        resume: the largest matching step <= epochs is used."""
+        from predictionio_tpu.core.checkpoint import CheckpointManager
+
+        inter = cyclic_interactions()
+        ck = str(tmp_path / "sasrec3")
+        base = dict(d_model=16, n_heads=2, n_layers=1, max_len=8,
+                    batch_size=16, seed=3)
+        train_sasrec(
+            ctx, inter,
+            SASRecConfig(epochs=4, checkpoint_dir=ck, checkpoint_interval=2,
+                         **base),
+        )
+        m = CheckpointManager(ck)
+        assert m.steps() == [2, 4]
+        state2 = m.restore(2)  # the epoch-2 params, verbatim
+        short = train_sasrec(
+            ctx, inter,
+            SASRecConfig(epochs=2, checkpoint_dir=ck, checkpoint_interval=2,
+                         **base),
+        )
+        # epochs=2 <= resumed step → zero further steps: output IS step_2
+        np.testing.assert_allclose(
+            short.params["emb"], np.asarray(state2["params"]["emb"]),
+            rtol=1e-6, atol=1e-7,
+        )
+
+    def test_sp_training_checkpoints_too(self, tmp_path):
+        import jax
+
+        from predictionio_tpu.core.checkpoint import CheckpointManager
+
+        ctx2 = MeshContext.create(
+            axes={"data": 2, "model": 4}, devices=jax.devices()[:8]
+        )
+        inter = cyclic_interactions()
+        ck = str(tmp_path / "sasrec_sp")
+        model = train_sasrec(
+            ctx2, inter,
+            SASRecConfig(d_model=16, n_heads=2, n_layers=1, max_len=8,
+                         epochs=2, batch_size=16, seq_parallel=True,
+                         checkpoint_dir=ck, checkpoint_interval=1),
+        )
+        assert CheckpointManager(ck).latest_step() == 2
+        assert np.all(np.isfinite(model.params["emb"]))
+
+
 class TestBuildSequences:
     def test_right_aligned_time_ordered(self):
         inter = cyclic_interactions(n_users=3, length=5)
