@@ -1,0 +1,53 @@
+"""Import $set user-property events for the classification quickstart.
+
+Parity: examples/scala-parallel-classification/*/data/import_eventserver.py
+— users carry attr0/attr1/attr2 features and a plan label set via $set.
+
+Usage:
+    python import_eventserver.py --access-key KEY [--url http://localhost:7070]
+"""
+
+import argparse
+import json
+import random
+import urllib.request
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--access-key", required=True)
+    p.add_argument("--url", default="http://localhost:7070")
+    p.add_argument("--users", type=int, default=120)
+    args = p.parse_args()
+
+    rng = random.Random(7)
+    events = []
+    for u in range(args.users):
+        premium = u % 2 == 0
+        base = 7.0 if premium else 2.0
+        events.append({
+            "event": "$set",
+            "entityType": "user",
+            "entityId": f"u{u}",
+            "properties": {
+                "attr0": base + rng.random() * 2,
+                "attr1": base + rng.random() * 2,
+                "attr2": rng.random() * 10,
+                "plan": "premium" if premium else "basic",
+            },
+        })
+
+    sent = 0
+    for i in range(0, len(events), 50):  # event server batch limit is 50
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            data=json.dumps(events[i : i + 50]).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            sent += sum(1 for x in json.loads(r.read()) if x["status"] == 201)
+    print(f"imported {sent} events")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Query the deployed classification engine with a feature vector.
+
+Usage:
+    python send_query.py [--url http://localhost:8000] --features 8.1 7.9 4.2
+"""
+
+import argparse
+import json
+import urllib.request
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--url", default="http://localhost:8000")
+    p.add_argument("--features", type=float, nargs=3, default=[8.0, 8.0, 5.0])
+    args = p.parse_args()
+    req = urllib.request.Request(
+        f"{args.url}/queries.json",
+        data=json.dumps({"features": args.features}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        print(json.dumps(json.loads(r.read()), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Import view/buy events + item category properties for the e-commerce
+quickstart.
+
+Parity: examples/scala-parallel-ecommercerecommendation/*/data/
+import_eventserver.py — items carry $set categories; the engine applies
+live rules (unseenOnly, category filters, white/black lists) at predict
+time against the event store.
+
+Usage:
+    python import_eventserver.py --access-key KEY [--url http://localhost:7070]
+"""
+
+import argparse
+import json
+import random
+import urllib.request
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--access-key", required=True)
+    p.add_argument("--url", default="http://localhost:7070")
+    p.add_argument("--users", type=int, default=50)
+    p.add_argument("--items", type=int, default=30)
+    args = p.parse_args()
+
+    rng = random.Random(13)
+    events = []
+    for i in range(args.items):
+        events.append({
+            "event": "$set",
+            "entityType": "item",
+            "entityId": f"i{i}",
+            "properties": {"categories": ["electronics" if i % 2 else "books"]},
+        })
+    for u in range(args.users):
+        for i in rng.sample(range(args.items), 8):
+            events.append({
+                "event": "view" if rng.random() < 0.7 else "buy",
+                "entityType": "user",
+                "entityId": f"u{u}",
+                "targetEntityType": "item",
+                "targetEntityId": f"i{i}",
+            })
+
+    sent = 0
+    for i in range(0, len(events), 50):
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            data=json.dumps(events[i : i + 50]).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            sent += sum(1 for x in json.loads(r.read()) if x["status"] == 201)
+    print(f"imported {sent} events")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Query the deployed sequential engine for a user's next items.
+
+Usage:
+    python send_query.py [--url http://localhost:8000] --user u1 [--num 3]
+"""
+
+import argparse
+import json
+import urllib.request
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--url", default="http://localhost:8000")
+    p.add_argument("--user", default="u1")
+    p.add_argument("--num", type=int, default=3)
+    args = p.parse_args()
+    req = urllib.request.Request(
+        f"{args.url}/queries.json",
+        data=json.dumps({"user": args.user, "num": args.num}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        print(json.dumps(json.loads(r.read()), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Import view events for the similar-product quickstart.
+
+Parity: examples/scala-parallel-similarproduct/*/data/import_eventserver.py
+— users view items; co-viewing defines similarity.
+
+Usage:
+    python import_eventserver.py --access-key KEY [--url http://localhost:7070]
+"""
+
+import argparse
+import json
+import random
+import urllib.request
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--access-key", required=True)
+    p.add_argument("--url", default="http://localhost:7070")
+    p.add_argument("--users", type=int, default=60)
+    p.add_argument("--items", type=int, default=40)
+    args = p.parse_args()
+
+    rng = random.Random(11)
+    events = []
+    for u in range(args.users):
+        # two taste clusters so co-occurrence has structure to find
+        lo, hi = (0, args.items // 2) if u % 2 else (args.items // 2, args.items)
+        for i in rng.sample(range(lo, hi), 6):
+            events.append({
+                "event": "view",
+                "entityType": "user",
+                "entityId": f"u{u}",
+                "targetEntityType": "item",
+                "targetEntityId": f"i{i}",
+            })
+
+    sent = 0
+    for i in range(0, len(events), 50):
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            data=json.dumps(events[i : i + 50]).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            sent += sum(1 for x in json.loads(r.read()) if x["status"] == 201)
+    print(f"imported {sent} events")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Query the deployed similar-product engine with a seed item list.
+
+Usage:
+    python send_query.py [--url http://localhost:8000] --items i1 i2 [--num 4]
+"""
+
+import argparse
+import json
+import urllib.request
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--url", default="http://localhost:8000")
+    p.add_argument("--items", nargs="+", default=["i1"])
+    p.add_argument("--num", type=int, default=4)
+    args = p.parse_args()
+    req = urllib.request.Request(
+        f"{args.url}/queries.json",
+        data=json.dumps({"items": args.items, "num": args.num}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        print(json.dumps(json.loads(r.read()), indent=2))
+
+
+if __name__ == "__main__":
+    main()

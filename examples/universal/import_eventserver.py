@@ -1,0 +1,54 @@
+"""Import multi-event (buy primary + view secondary) data for the
+Universal Recommender quickstart.
+
+The UR's cross-occurrence needs a primary conversion event plus secondary
+indicator events; views correlate with later buys here.
+
+Usage:
+    python import_eventserver.py --access-key KEY [--url http://localhost:7070]
+"""
+
+import argparse
+import json
+import random
+import urllib.request
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--access-key", required=True)
+    p.add_argument("--url", default="http://localhost:7070")
+    p.add_argument("--users", type=int, default=80)
+    p.add_argument("--items", type=int, default=40)
+    args = p.parse_args()
+
+    rng = random.Random(17)
+    events = []
+    for u in range(args.users):
+        lo, hi = (0, args.items // 2) if u % 2 else (args.items // 2, args.items)
+        viewed = rng.sample(range(lo, hi), 8)
+        for i in viewed:
+            events.append({
+                "event": "view", "entityType": "user", "entityId": f"u{u}",
+                "targetEntityType": "item", "targetEntityId": f"i{i}",
+            })
+        for i in viewed[:3]:  # a subset of views convert
+            events.append({
+                "event": "buy", "entityType": "user", "entityId": f"u{u}",
+                "targetEntityType": "item", "targetEntityId": f"i{i}",
+            })
+
+    sent = 0
+    for i in range(0, len(events), 50):
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            data=json.dumps(events[i : i + 50]).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            sent += sum(1 for x in json.loads(r.read()) if x["status"] == 201)
+    print(f"imported {sent} events")
+
+
+if __name__ == "__main__":
+    main()

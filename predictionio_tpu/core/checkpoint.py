@@ -89,14 +89,32 @@ class CheckpointManager:
         steps = self.steps()
         return steps[-1] if steps else None
 
+    def _fp_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}.fp.npy")
+
     def save(self, step: int, tree: Any) -> None:
         save_pytree(self._step_dir(step), tree)
+        # fingerprint sidecar: resume_from can reject a non-matching step
+        # without restoring its full (possibly multi-GB) state
+        if isinstance(tree, dict) and tree.get("fingerprint") is not None:
+            np.save(self._fp_path(step), np.asarray(tree["fingerprint"]))
         # retention: drop oldest beyond keep
         import shutil
 
         steps = self.steps()
         for old in steps[: max(0, len(steps) - self.keep)]:
             shutil.rmtree(self._step_dir(old), ignore_errors=True)
+            try:
+                os.remove(self._fp_path(old))
+            except FileNotFoundError:
+                pass
+
+    def saved_fingerprint(self, step: int):
+        """The sidecar fingerprint for ``step``, or None if absent."""
+        try:
+            return np.load(self._fp_path(step))
+        except (FileNotFoundError, ValueError):
+            return None
 
     def restore(self, step: Optional[int] = None, ctx=None, shardings=None) -> Any:
         step = self.latest_step() if step is None else step
@@ -121,6 +139,16 @@ def resume_from(manager: CheckpointManager, fingerprint, max_step: int):
         if step > max_step:
             skipped_high.append(step)
             continue
+        # cheap rejection via the sidecar before touching the full state
+        side = manager.saved_fingerprint(step)
+        if side is not None and not (
+            side.shape == want.shape and np.allclose(side, want)
+        ):
+            logger.warning(
+                "checkpoint step %d under %s does not match this "
+                "config/dataset; ignoring", step, manager.directory,
+            )
+            continue
         state = manager.restore(step)  # host pytree
         got = np.asarray(state.get("fingerprint"))
         if got.shape == want.shape and np.allclose(got, want):
@@ -135,3 +163,14 @@ def resume_from(manager: CheckpointManager, fingerprint, max_step: int):
             "starting fresh", skipped_high, manager.directory, max_step,
         )
     return 0, None
+
+
+def validate_interval(interval: int) -> None:
+    if interval < 1:
+        raise ValueError(f"checkpoint_interval must be >= 1, got {interval}")
+
+
+def save_due(step_done: int, interval: int, total_steps: int) -> bool:
+    """The save cadence both trainers follow: every ``interval`` completed
+    steps, plus always at the end of the run."""
+    return step_done % interval == 0 or step_done == total_steps

@@ -308,12 +308,13 @@ def train_als(
     start_iter = 0
     manager = None
     if cfg.checkpoint_dir:
-        if cfg.checkpoint_interval < 1:
-            raise ValueError(
-                f"checkpoint_interval must be >= 1, got {cfg.checkpoint_interval}"
-            )
-        from predictionio_tpu.core.checkpoint import CheckpointManager
+        from predictionio_tpu.core.checkpoint import (
+            CheckpointManager,
+            save_due,
+            validate_interval,
+        )
 
+        validate_interval(cfg.checkpoint_interval)
         manager = CheckpointManager(cfg.checkpoint_dir)
         # fingerprint ties checkpoints to THIS config + dataset: a stale or
         # foreign checkpoint is ignored (fresh start), never silently loaded
@@ -342,8 +343,8 @@ def train_als(
 
     for it in range(start_iter, cfg.iterations):
         U, V = step(U, V, u_blocks, i_blocks)
-        if manager is not None and (
-            (it + 1) % cfg.checkpoint_interval == 0 or it + 1 == cfg.iterations
+        if manager is not None and save_due(
+            it + 1, cfg.checkpoint_interval, cfg.iterations
         ):
             manager.save(
                 it + 1, {"U": U, "V": V, "fingerprint": fingerprint}

@@ -22,7 +22,6 @@ exchange collectives.
 from __future__ import annotations
 
 import dataclasses
-import logging
 from functools import partial
 from typing import Optional
 
@@ -40,8 +39,6 @@ from predictionio_tpu.parallel.mesh import (
     pad_to_multiple,
 )
 from predictionio_tpu.parallel.ring import full_attention
-
-logger = logging.getLogger(__name__)
 
 PAD = 0  # item ids are shifted by +1; 0 is the padding token
 
@@ -450,15 +447,14 @@ def train_sasrec(
     manager = None
     fingerprint = None
     if cfg.checkpoint_dir:
-        if cfg.checkpoint_interval < 1:
-            raise ValueError(
-                f"checkpoint_interval must be >= 1, got {cfg.checkpoint_interval}"
-            )
         from predictionio_tpu.core.checkpoint import (
             CheckpointManager,
             resume_from,
+            save_due,
+            validate_interval,
         )
 
+        validate_interval(cfg.checkpoint_interval)
         manager = CheckpointManager(cfg.checkpoint_dir)
         fingerprint = np.array(
             [
@@ -486,7 +482,13 @@ def train_sasrec(
             leaves, treedef = jax.tree.flatten(opt_state)
             opt_state = jax.tree.unflatten(
                 treedef,
-                [put_like(r, leaf) for r, leaf in zip(restored["opt"], leaves)],
+                [
+                    put_like(r, leaf)
+                    # strict: a leaf-count mismatch (e.g. a different optax
+                    # version) must fail loudly, not mix restored and fresh
+                    # moments
+                    for r, leaf in zip(restored["opt"], leaves, strict=True)
+                ],
             )
 
     rng = np.random.default_rng(cfg.seed)
@@ -497,9 +499,8 @@ def train_sasrec(
     for epoch in range(start_epoch, cfg.epochs):
         picks = rng.integers(0, n, batch)
         params, opt_state, loss = run_step(params, opt_state, seqs[picks])
-        if manager is not None and (
-            (epoch + 1) % cfg.checkpoint_interval == 0
-            or epoch + 1 == cfg.epochs
+        if manager is not None and save_due(
+            epoch + 1, cfg.checkpoint_interval, cfg.epochs
         ):
             manager.save(
                 epoch + 1,
