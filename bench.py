@@ -93,11 +93,21 @@ def main() -> None:
     ctx = MeshContext.create()
     n_chips = ctx.n_devices
 
+    # BENCH_DTYPE=bf16 benches the bf16 gather/all-gather path (f32 solve
+    # accumulation either way); default stays f32
+    dtype = os.environ.get("BENCH_DTYPE", "f32")
+
     # warm-up: compile the step (first TPU compile is slow, cached after)
-    als.train_als(ctx, inter, als.ALSConfig(rank=rank, iterations=1))
+    als.train_als(
+        ctx, inter,
+        als.ALSConfig(rank=rank, iterations=1, compute_dtype=dtype),
+    )
 
     t0 = time.perf_counter()
-    als.train_als(ctx, inter, als.ALSConfig(rank=rank, iterations=iterations))
+    als.train_als(
+        ctx, inter,
+        als.ALSConfig(rank=rank, iterations=iterations, compute_dtype=dtype),
+    )
     dt = time.perf_counter() - t0
 
     events_per_sec_per_chip = n_ratings * iterations / dt / n_chips

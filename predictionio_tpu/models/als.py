@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from functools import partial
 from typing import Optional
 
@@ -154,7 +155,8 @@ def _make_blocks(
 
 # Ratings processed per scan step: bounds the (chunk, k, k) outer-product
 # intermediate so HBM peak stays flat however many ratings a shard holds.
-_CHUNK = 65536
+# PIO_ALS_CHUNK overrides for hardware tuning (benchmarked, not guessed).
+_CHUNK = int(os.environ.get("PIO_ALS_CHUNK", 65536))
 
 
 def _half_step_local(

@@ -433,12 +433,82 @@ class PrecisionAtK(OptionAverageMetric):
         return tp / min(self.k, len(positives))
 
 
-class RecommendationEvaluation(Evaluation, EngineParamsGenerator):
-    """Grid over ALS rank (parity: Evaluation.scala + ParamsList)."""
+class NDCGAtK(OptionAverageMetric):
+    """Normalized discounted cumulative gain over the top-k ranking.
 
-    def __init__(self, app_name: str = "default", ranks=(4, 8), k: int = 10):
+    Beyond-reference ranking metric (the reference's examples stop at
+    Precision@K): position-aware, gain 1 for each held-out actual, ideal
+    DCG over min(k, |positives|) positions.
+    """
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"NDCG@{self.k}"
+
+    def calculate_one(self, query, prediction, actual) -> Optional[float]:
+        import math
+
+        top = [s.item for s in prediction.itemScores[: self.k]]
+        positives = set(actual)
+        if not top or not positives:
+            return None
+        dcg = sum(
+            1.0 / math.log2(i + 2) for i, it in enumerate(top) if it in positives
+        )
+        ideal = sum(
+            1.0 / math.log2(i + 2) for i in range(min(self.k, len(positives)))
+        )
+        return dcg / ideal
+
+
+class MAPAtK(OptionAverageMetric):
+    """Mean average precision at k (average of precision at each hit rank)."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"MAP@{self.k}"
+
+    def calculate_one(self, query, prediction, actual) -> Optional[float]:
+        top = [s.item for s in prediction.itemScores[: self.k]]
+        positives = set(actual)
+        if not top or not positives:
+            return None
+        hits = 0
+        precision_sum = 0.0
+        for i, it in enumerate(top):
+            if it in positives:
+                hits += 1
+                precision_sum += hits / (i + 1)
+        return precision_sum / min(self.k, len(positives))
+
+
+_METRICS = {"precision": PrecisionAtK, "ndcg": NDCGAtK, "map": MAPAtK}
+
+
+class RecommendationEvaluation(Evaluation, EngineParamsGenerator):
+    """Grid over ALS rank (parity: Evaluation.scala + ParamsList).
+
+    ``metric`` selects the tuning objective ("precision", "ndcg", "map");
+    the other two report alongside it (MetricEvaluator extra columns).
+    """
+
+    def __init__(self, app_name: str = "default", ranks=(4, 8), k: int = 10,
+                 metric: str = "precision"):
+        if metric not in _METRICS:
+            raise ValueError(
+                f"metric must be one of {sorted(_METRICS)}, got {metric!r}"
+            )
         self.engine = RecommendationEngine.apply()
-        self.metric = PrecisionAtK(k=k)
+        self.metric = _METRICS[metric](k=k)
+        self.metrics = [
+            cls(k=k) for name, cls in _METRICS.items() if name != metric
+        ]
         self.engine_params_list = [
             self.engine.params_from_variant(
                 {

@@ -194,3 +194,59 @@ class TestTemplateEvaluation:
         assert m.calculate_one(None, pred, ["a", "z"]) == 0.5
         assert m.calculate_one(None, PredictedResult(itemScores=[]), ["a"]) is None
         assert m.header == "Precision@2"
+
+    def test_ndcg_at_k(self):
+        import math
+
+        from predictionio_tpu.templates.recommendation import (
+            ItemScore,
+            NDCGAtK,
+            PredictedResult,
+        )
+
+        m = NDCGAtK(k=4)
+        pred = PredictedResult(
+            itemScores=[ItemScore(i, 1.0) for i in ("a", "b", "c", "d")]
+        )
+        # hits at ranks 1 and 3: dcg = 1 + 1/log2(4); ideal = 1 + 1/log2(3)
+        want = (1.0 + 1.0 / 2.0) / (1.0 + 1.0 / math.log2(3))
+        got = m.calculate_one(None, pred, ["a", "c"])
+        assert abs(got - want) < 1e-9
+        # perfect ranking → 1.0
+        assert m.calculate_one(None, pred, ["a", "b", "c", "d"]) == 1.0
+        assert m.calculate_one(None, PredictedResult(itemScores=[]), ["a"]) is None
+        assert m.header == "NDCG@4"
+
+    def test_map_at_k(self):
+        from predictionio_tpu.templates.recommendation import (
+            ItemScore,
+            MAPAtK,
+            PredictedResult,
+        )
+
+        m = MAPAtK(k=4)
+        pred = PredictedResult(
+            itemScores=[ItemScore(i, 1.0) for i in ("a", "b", "c", "d")]
+        )
+        # hits at ranks 1 (prec 1/1) and 3 (prec 2/3), / min(k, 2)
+        got = m.calculate_one(None, pred, ["a", "c"])
+        assert abs(got - (1.0 + 2.0 / 3.0) / 2.0) < 1e-9
+        assert m.calculate_one(None, pred, ["a", "b"]) == 1.0
+        assert m.calculate_one(None, PredictedResult(itemScores=[]), ["a"]) is None
+        assert m.header == "MAP@4"
+
+    def test_evaluation_metric_selector(self):
+        from predictionio_tpu.templates.recommendation import (
+            NDCGAtK,
+            RecommendationEvaluation,
+        )
+
+        ev = RecommendationEvaluation(metric="ndcg", k=5)
+        assert isinstance(ev.metric, NDCGAtK)
+        headers = [m.header for m in ev.all_metrics]
+        assert headers[0] == "NDCG@5"
+        assert {"Precision@5", "MAP@5"} <= set(headers)
+        import pytest
+
+        with pytest.raises(ValueError, match="metric"):
+            RecommendationEvaluation(metric="nope")
