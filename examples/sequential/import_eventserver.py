@@ -8,6 +8,7 @@ Usage:
 """
 
 import argparse
+import datetime
 import json
 import random
 import urllib.request
@@ -33,7 +34,12 @@ def main():
                 "entityId": f"u{u}",
                 "targetEntityType": "item",
                 "targetEntityId": f"i{(start + t) % args.items}",
-                "eventTime": f"2026-01-01T{t:02d}:00:00.000Z",
+                # base + timedelta keeps any --length valid (hour arithmetic
+                # beyond 24 would otherwise emit impossible timestamps)
+                "eventTime": (
+                    datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+                    + datetime.timedelta(hours=t)
+                ).strftime("%Y-%m-%dT%H:%M:%S.000Z"),
             })
 
     sent = 0

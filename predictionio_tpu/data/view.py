@@ -128,6 +128,12 @@ def create(
 
     from predictionio_tpu.data.store import PEventStore
 
+    # normalize tz-naive bounds to UTC: comparing naive against the tz-aware
+    # utcnow() below would raise a bare TypeError mid-call otherwise
+    if start_time is not None and start_time.tzinfo is None:
+        start_time = start_time.replace(tzinfo=_dt.timezone.utc)
+    if until_time is not None and until_time.tzinfo is None:
+        until_time = until_time.replace(tzinfo=_dt.timezone.utc)
     begin = start_time or _dt.datetime.fromtimestamp(0, _dt.timezone.utc)
     end = until_time or utcnow()  # fix the current time (DataView.scala:73-76)
     if cache is None:

@@ -63,6 +63,11 @@ class ALSConfig:
     # "bf16": store/gather the opposite factor matrix in bfloat16 (halves
     # the gather + all-gather HBM traffic); all arithmetic stays f32.
     compute_dtype: str = "f32"
+    # Relabel entities by rating count (round-robin hot entities across
+    # shards) before range-blocking, so Zipf-skewed catalogs don't pad
+    # every shard to the hottest block's length. Pure host-side; factors
+    # are returned in original id order either way.
+    rebalance: bool = True
 
     def __post_init__(self):
         if self.compute_dtype not in ("f32", "bf16"):
@@ -100,6 +105,39 @@ class _Blocks:
     mask: np.ndarray  # (n_shards*L,) float32 1=real 0=padding
     per_shard: int  # entities per shard
     length: int  # L = ratings per shard (padded)
+
+
+def _balance_permutation(
+    entity: np.ndarray, n_entity_pad: int, n_shards: int
+) -> np.ndarray:
+    """Old-id → new-id relabeling that balances per-shard rating counts.
+
+    Range-blocking pads every shard to the hottest block's rating count
+    (`_make_blocks`); under a Zipf catalog the hot entities cluster in a few
+    id ranges and the other shards burn idle FLOPs on padding.  LPT-style
+    fix: order entities by descending count and deal them round-robin
+    across shards, so each shard holds an equal slice of the popularity
+    curve.  Returns ``perm`` with ``perm[old_id] = new_id`` (a bijection on
+    ``[0, n_entity_pad)``); blocking then uses ``perm[entity]``.
+    """
+    import heapq
+
+    counts = np.bincount(entity, minlength=n_entity_pad)
+    order = np.argsort(-counts, kind="stable")  # hottest first
+    per_shard = n_entity_pad // n_shards
+    perm = np.empty(n_entity_pad, np.int64)
+    # LPT greedy with capacity: hottest entity → lightest shard with a free
+    # slot. Guarantees max load ≤ mean + hottest single entity; the heap is
+    # (load, shard) so ties break deterministically by shard index.
+    heap = [(0, p) for p in range(n_shards)]
+    used = np.zeros(n_shards, np.int64)
+    for o in order:
+        load, p = heapq.heappop(heap)
+        perm[o] = p * per_shard + used[p]
+        used[p] += 1
+        if used[p] < per_shard:  # full shards leave the heap; capacities sum
+            heapq.heappush(heap, (load + int(counts[o]), p))  # to n_entity_pad
+    return perm
 
 
 def _make_blocks(
@@ -283,8 +321,17 @@ def train_als(
     item = interactions.item.astype(np.int64)
     rating = interactions.rating.astype(np.float32)
 
-    ub = _make_blocks(user, item, rating, n_users_pad, n_shards)
-    ib = _make_blocks(item, user, rating, n_items_pad, n_shards)
+    u_perm = i_perm = None
+    if cfg.rebalance and n_shards > 1:
+        u_perm = _balance_permutation(user, n_users_pad, n_shards)
+        i_perm = _balance_permutation(item, n_items_pad, n_shards)
+        user_blk = u_perm[user]
+        item_blk = i_perm[item]
+    else:
+        user_blk, item_blk = user, item
+
+    ub = _make_blocks(user_blk, item_blk, rating, n_users_pad, n_shards)
+    ib = _make_blocks(item_blk, user_blk, rating, n_items_pad, n_shards)
 
     key = jax.random.PRNGKey(cfg.seed)
     ku, kv = jax.random.split(key)
@@ -333,6 +380,9 @@ def train_als(
                 float(np.sum(item, dtype=np.float64)),
                 float(cfg.reg),
                 float(cfg.alpha),
+                # rebalance changes the on-disk row order of U/V: a
+                # checkpoint from the other layout must not resume
+                int(cfg.rebalance),
             ],
             dtype=np.float64,
         )
@@ -351,8 +401,12 @@ def train_als(
             manager.save(
                 it + 1, {"U": U, "V": V, "fingerprint": fingerprint}
             )
-    U_host = np.asarray(jax.device_get(U))[:n_users]
-    V_host = np.asarray(jax.device_get(V))[:n_items]
+    U_all = np.asarray(jax.device_get(U))
+    V_all = np.asarray(jax.device_get(V))
+    # factor row new_id belongs to old entity id o with perm[o] == new_id;
+    # return in original id order so the model is permutation-invisible
+    U_host = U_all[u_perm[:n_users]] if u_perm is not None else U_all[:n_users]
+    V_host = V_all[i_perm[:n_items]] if i_perm is not None else V_all[:n_items]
     return ALSModel(
         user_factors=U_host,
         item_factors=V_host,

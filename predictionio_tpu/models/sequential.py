@@ -22,6 +22,7 @@ exchange collectives.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from functools import partial
 from typing import Optional
 
@@ -461,7 +462,17 @@ def train_sasrec(
                 n_items, n, batch, cfg.d_model, cfg.n_layers, cfg.n_heads,
                 cfg.max_len, float(cfg.lr), cfg.seed, cfg.n_experts,
                 float(cfg.expert_capacity), float(cfg.moe_aux_weight),
-                int(cfg.seq_parallel), float(np.sum(seqs, dtype=np.float64)),
+                # order-sensitive dataset digest: a reordered/swapped history
+                # set must NOT resume from a foreign checkpoint (plain
+                # element sums are permutation-blind); 48 hex bits so the
+                # value is exact in this float64 array
+                int(
+                    hashlib.sha1(
+                        np.ascontiguousarray(seqs).tobytes()
+                    ).hexdigest()[:12],
+                    16,
+                ),
+                int(cfg.seq_parallel),
             ],
             dtype=np.float64,
         )

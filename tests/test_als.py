@@ -78,6 +78,68 @@ class TestExplicitALS:
         assert np.linalg.norm(hi.user_factors) < np.linalg.norm(lo.user_factors)
 
 
+class TestLoadRebalance:
+    """Zipf-skewed catalogs must not pad every shard to the hot block's size.
+
+    VERDICT r2 item 2: range-blocking with contiguous hot ids concentrates
+    ratings in one shard; `_balance_permutation` deals entities round-robin
+    by popularity so per-shard counts stay near the mean.
+    """
+
+    @staticmethod
+    def _zipf_ids(rng, n, size, s=1.1, q=20):
+        # Zipf-Mandelbrot: the q shift flattens the head the way real
+        # catalogs look (ML-25M's hottest movie holds ~0.3% of ratings,
+        # not the ~10% a pure Zipf head would)
+        ranks = np.arange(1, n + 1, dtype=np.float64)
+        p = (ranks + q) ** -s
+        p /= p.sum()
+        return rng.choice(n, size=size, p=p).astype(np.int64)
+
+    def test_permutation_is_bijection_and_balances(self, ctx):
+        from predictionio_tpu.models.als import _balance_permutation
+
+        rng = np.random.default_rng(0)
+        n_shards = ctx.axis_size("data")
+        n_items, n_ratings = 400, 20_000
+        n_pad = ((n_items + n_shards - 1) // n_shards) * n_shards
+        items = self._zipf_ids(rng, n_items, n_ratings)
+        perm = _balance_permutation(items, n_pad, n_shards)
+        assert sorted(perm) == list(range(n_pad))  # bijection
+        per_shard = n_pad // n_shards
+        shard_counts = np.bincount(perm[items] // per_shard, minlength=n_shards)
+        mean = n_ratings / n_shards
+        assert shard_counts.max() <= 1.15 * mean, shard_counts
+
+    def test_blocked_padding_shrinks_under_rebalance(self, ctx):
+        from predictionio_tpu.models.als import _balance_permutation, _make_blocks
+
+        rng = np.random.default_rng(1)
+        n_shards = ctx.axis_size("data")
+        n_items, n_ratings = 800, 40_000
+        n_pad = ((n_items + n_shards - 1) // n_shards) * n_shards
+        items = self._zipf_ids(rng, n_items, n_ratings)
+        users = rng.integers(0, 100, n_ratings).astype(np.int64)
+        ratings = rng.uniform(1, 5, n_ratings).astype(np.float32)
+        raw = _make_blocks(items, users, ratings, n_pad, n_shards)
+        perm = _balance_permutation(items, n_pad, n_shards)
+        balanced = _make_blocks(perm[items], users, ratings, n_pad, n_shards)
+        # hot ids contiguous → raw padding near worst case; balanced within
+        # ~15% of the ideal equal split
+        assert balanced.length <= 1.15 * (n_ratings / n_shards)
+        assert balanced.length < raw.length
+
+    def test_model_invariant_under_rebalance(self, ctx):
+        # factors come back in original id order: ranking quality matches
+        # the unbalanced path on the same data
+        inter = synthetic_explicit(n_users=40, n_items=30)
+        cfg = dict(rank=3, iterations=10, reg=0.001)
+        on = train_als(ctx, inter, ALSConfig(rebalance=True, **cfg))
+        off = train_als(ctx, inter, ALSConfig(rebalance=False, **cfg))
+        assert abs(rmse(on, inter) - rmse(off, inter)) < 0.02
+        assert rmse(on, inter) < 0.05
+
+
 def dense_reference_half_step(V, users, items, ratings, n_users, reg,
                               implicit=False, alpha=1.0):
     """Straight-from-the-paper dense solve for U given V (numpy, no jax)."""
