@@ -263,10 +263,28 @@ class MetricEvaluator:
         return qpas
 
     def _summary(self, results, best) -> str:
+        """Per-candidate metric columns + the best row, like the reference's
+        MetricEvaluator printout (MetricEvaluator.scala:218-263)."""
+        headers = [self.metric.header] + [m.header for m in self.metrics]
+        widths = [max(len(h), 12) for h in headers]
         lines = [
             "[RESULT] Metric evaluation",
             f"  candidates: {len(results)}",
             f"  metric: {self.metric.header}",
+            "  "
+            + " | ".join(h.ljust(w) for h, w in zip(["#"] + headers, [3] + widths))
+            + " | params",
+        ]
+        for i, r in enumerate(results):
+            cells = [f"{r.score:.6g}"] + [f"{s:.6g}" for s in r.other_scores]
+            mark = "*" if r is best else " "
+            lines.append(
+                f"  {mark}{i:<2} | "
+                + " | ".join(c.ljust(w) for c, w in zip(cells, widths))
+                + " | "
+                + r.engine_params.to_json_strings()["algorithms_params"]
+            )
+        lines += [
             f"  best score: {best.score}",
             f"  best params: {best.engine_params.to_json_strings()['algorithms_params']}",
         ]

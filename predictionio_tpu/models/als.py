@@ -380,9 +380,11 @@ def train_als(
                 float(np.sum(item, dtype=np.float64)),
                 float(cfg.reg),
                 float(cfg.alpha),
-                # rebalance changes the on-disk row order of U/V: a
-                # checkpoint from the other layout must not resume
+                # rebalance + shard count determine the on-disk row order
+                # of U/V (the permutation is a function of both): a
+                # checkpoint from any other layout must not resume
                 int(cfg.rebalance),
+                n_shards,
             ],
             dtype=np.float64,
         )

@@ -1,0 +1,175 @@
+"""In-process TTL cache with async refresh for serving-time event lookups.
+
+Why this exists (SURVEY.md §7 "hard parts"): the e-commerce template's
+predict path consults the live event store per query — the user's seen-item
+set and the ``unavailableItems`` constraint entity (reference parity:
+``examples/scala-parallel-ecommercerecommendation/adjust-score/src/main/
+scala/ECommAlgorithm.scala:332-360``, which does a timed
+``LEventStore.findByEntity`` on every request).  A storage round-trip in
+the <10 ms REST predict path makes filtered-query latency storage-bound;
+with a remote (network-driver) event store it dominates outright.
+
+:class:`ServingEventCache` keeps the hot path in process memory:
+
+* **miss** → load synchronously (first query for a user pays one read);
+* **hit** → return the cached value immediately, never touching storage;
+* **stale hit** (older than ``refresh_interval``) → still returns the
+  cached value with zero storage reads, and schedules a refresh on a
+  single background worker thread (deduplicated per key), so new events
+  appear within one refresh interval without a query ever blocking.
+
+Steady state therefore makes ZERO storage round-trips on the request path.
+Thread-safe; the query server handles requests on multiple threads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Hashable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    refreshes: int = 0
+    evictions: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ServingEventCache:
+    """Key → value cache with background refresh after ``refresh_interval``.
+
+    ``loader`` callables are supplied per ``get`` so one cache can serve
+    heterogeneous lookups (seen-sets keyed by user, constraint entities,
+    item properties...).  A failed refresh keeps the previous value and
+    logs — serving stays up on a flaky store (matching the template's
+    existing degrade-gracefully behavior on lookup errors).
+    """
+
+    def __init__(
+        self,
+        refresh_interval: float = 5.0,
+        max_entries: int = 100_000,
+        clock: Callable[[], float] = time.monotonic,
+        refresh_timeout: float = 30.0,
+        refresh_workers: int = 4,
+    ):
+        self.refresh_interval = float(refresh_interval)
+        self.max_entries = int(max_entries)
+        self.refresh_timeout = float(refresh_timeout)
+        self.refresh_workers = int(refresh_workers)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # insertion/refresh-ordered so eviction is O(1) popitem(last=False)
+        # instead of a min-scan under the lock on the serving path
+        self._data: "OrderedDict[Hashable, tuple[Any, float]]" = OrderedDict()
+        # key → wall-clock start of the in-flight refresh; entries older
+        # than refresh_timeout are presumed hung (e.g. a TCP black hole on
+        # a remote store) and no longer block a new refresh of that key
+        self._inflight: dict[Hashable, float] = {}
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self.stats = CacheStats()
+
+    # -- core ---------------------------------------------------------------
+    def get(self, key: Hashable, loader: Callable[[], Any]) -> Any:
+        now = self._clock()
+        with self._lock:
+            entry = self._data.get(key)
+            if entry is not None:
+                self.stats.hits += 1
+        if entry is not None:
+            value, loaded_at = entry
+            if now - loaded_at >= self.refresh_interval:
+                self._schedule_refresh(key, loader)
+            return value
+        value = loader()
+        with self._lock:
+            self.stats.misses += 1
+            self._data[key] = (value, now)
+            self._data.move_to_end(key)
+            self._evict_locked()
+        return value
+
+    def invalidate(self, key: Hashable) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    # -- internals ----------------------------------------------------------
+    def _evict_locked(self) -> None:
+        # stalest-first (insertion/refresh order) O(1) eviction; max_entries
+        # bounds resident memory for unbounded user populations
+        while len(self._data) > self.max_entries:
+            self._data.popitem(last=False)
+            self.stats.evictions += 1
+
+    def _schedule_refresh(self, key: Hashable, loader: Callable[[], Any]) -> None:
+        started = time.monotonic()
+        with self._lock:
+            inflight_since = self._inflight.get(key)
+            if (
+                inflight_since is not None
+                and started - inflight_since < self.refresh_timeout
+            ):
+                return  # a live refresh is already running for this key
+            # either no refresh in flight, or the previous one is presumed
+            # hung (its thread, if still alive, loses the write race below)
+            self._inflight[key] = started
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self.refresh_workers,
+                    thread_name_prefix="event-cache-refresh",
+                )
+            executor = self._executor
+
+        def work():
+            try:
+                value = loader()
+                with self._lock:
+                    # a superseded (hung-then-completed) refresh must not
+                    # clobber a newer one's in-flight bookkeeping
+                    if self._inflight.get(key) == started:
+                        self._data[key] = (value, self._clock())
+                        self._data.move_to_end(key)
+                        self.stats.refreshes += 1
+            except Exception:
+                logger.exception("cache refresh for %r failed; keeping stale", key)
+            finally:
+                with self._lock:
+                    if self._inflight.get(key) == started:
+                        del self._inflight[key]
+
+        executor.submit(work)
+
+    def wait_refreshes(self, timeout: float = 5.0) -> None:
+        """Block until no refresh is in flight (tests / graceful shutdown)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._inflight:
+                    return
+            time.sleep(0.005)
+        raise TimeoutError("cache refreshes still in flight")
+
+    def close(self) -> None:
+        with self._lock:
+            executor, self._executor = self._executor, None
+        if executor is not None:
+            executor.shutdown(wait=False)

@@ -17,6 +17,13 @@ Capability parity with ``examples/scala-parallel-ecommercerecommendation/``
   ``boostCategories`` hook.
 * train-with-rate-event variant: ``ratingKey`` datasource param reads
   graded events as the implicit-confidence weight.
+
+Deliberate deviation from the reference: serving-time lookups go through an
+in-process TTL cache with async refresh (``serving/event_cache.py``), so
+steady-state filtered queries make ZERO storage round-trips — new events
+become visible within ``cacheRefreshSeconds`` (default 5) instead of
+immediately.  Set ``cacheRefreshSeconds: 0`` for the reference's
+read-storage-every-query semantics.
 """
 
 from __future__ import annotations
@@ -121,6 +128,12 @@ class ECommAlgorithmParams(Params):
     # item ids with a weight multiplied into their scores before ranking,
     # e.g. [{"items": ["i1", "i2"], "weight": 2.0}]
     weightedItems: Optional[list] = None
+    # serving-time event cache (SURVEY.md §7): seen-sets and constraint
+    # entities are served from an in-process TTL cache with async refresh,
+    # so steady-state filtered queries make zero storage round-trips. New
+    # events appear within this many seconds; 0 reads storage every query
+    # (the reference's behavior, ECommAlgorithm.scala:332-360).
+    cacheRefreshSeconds: float = 5.0
 
     json_aliases = {"lambda": "reg"}
 
@@ -157,8 +170,27 @@ class ECommAlgorithm(Algorithm):
             als=als, popular=popular, item_categories=pd.item_categories
         )
 
-    # -- live lookups (parity: predict-time LEventStore reads :332-360) -----
+    # -- live lookups (parity: predict-time LEventStore reads :332-360),
+    # served through the in-process TTL cache so steady-state queries make
+    # zero storage round-trips (SURVEY.md §7) ------------------------------
+    @property
+    def _cache(self):
+        cache = getattr(self, "_event_cache", None)
+        if cache is None:
+            from predictionio_tpu.serving.event_cache import ServingEventCache
+
+            cache = ServingEventCache(
+                refresh_interval=self.params.cacheRefreshSeconds
+            )
+            self._event_cache = cache
+        return cache
+
     def _seen_items(self, user: str) -> set:
+        if self.params.cacheRefreshSeconds > 0:
+            return self._cache.get(("seen", user), lambda: self._load_seen(user))
+        return self._load_seen(user)
+
+    def _load_seen(self, user: str) -> set:
         try:
             events = LEventStore.find_by_entity(
                 self.params.appName,
@@ -174,6 +206,13 @@ class ECommAlgorithm(Algorithm):
             return set()
 
     def _unavailable_items(self) -> set:
+        if self.params.cacheRefreshSeconds > 0:
+            return self._cache.get(
+                ("constraint", "unavailableItems"), self._load_unavailable
+            )
+        return self._load_unavailable()
+
+    def _load_unavailable(self) -> set:
         try:
             events = LEventStore.find_by_entity(
                 self.params.appName,

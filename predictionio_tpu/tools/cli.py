@@ -289,9 +289,12 @@ def cmd_eval(args) -> int:
         storage=_storage(),
         ctx=make_ctx(variant) if variant else None,
         batch=args.batch or "",
+        output_path=args.output_best,
     )
     print(f"[INFO] Evaluation completed. Instance ID: {result.instance_id}")
     print(result.summary)
+    if args.output_best:
+        print(f"[INFO] Best engine params written to {args.output_best}")
     return 0
 
 
@@ -598,6 +601,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("engine_params_generator_class", nargs="?", default=None)
     add_engine_args(sp)
     sp.add_argument("--batch", default="")
+    sp.add_argument(
+        "--output-best",
+        default=None,
+        metavar="PATH",
+        help="write the best engine params as JSON (parity: "
+        "MetricEvaluator.saveEngineJson best.json, MetricEvaluator.scala:193)",
+    )
     sp.set_defaults(func=cmd_eval)
 
     sp = sub.add_parser("deploy")

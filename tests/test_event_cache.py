@@ -1,0 +1,236 @@
+"""Serving-time event cache: TTL + async refresh (SURVEY.md §7 hard part).
+
+The done criterion (VERDICT r2 item 4): a cache hit serves without touching
+storage, new events appear after refresh, and the e-commerce filtered
+predict path makes zero storage round-trips at steady state.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import Event
+from predictionio_tpu.data import store as store_mod
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.serving.event_cache import ServingEventCache
+
+
+@pytest.fixture()
+def app(storage):
+    store_mod.set_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(App(0, "tapp"))
+    storage.get_l_events().init(app_id)
+    yield {"storage": storage, "app_id": app_id, "le": storage.get_l_events()}
+    store_mod.set_storage(None)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create()
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+class TestServingEventCache:
+    def test_miss_loads_then_hits_serve_from_memory(self):
+        clock = FakeClock()
+        cache = ServingEventCache(refresh_interval=5.0, clock=clock)
+        calls = []
+        loader = lambda: calls.append(1) or {"a"}
+        assert cache.get("k", loader) == {"a"}
+        assert cache.get("k", loader) == {"a"}
+        assert cache.get("k", loader) == {"a"}
+        assert len(calls) == 1  # one storage read ever
+        assert cache.stats.hits == 2 and cache.stats.misses == 1
+
+    def test_stale_hit_returns_old_value_and_refreshes_async(self):
+        clock = FakeClock()
+        cache = ServingEventCache(refresh_interval=5.0, clock=clock)
+        state = {"value": {"old"}}
+        cache.get("k", lambda: state["value"])
+        state["value"] = {"new"}
+        clock.now += 10  # entry is now stale
+        # stale hit: serves old value with no synchronous load
+        assert cache.get("k", lambda: state["value"]) == {"old"}
+        cache.wait_refreshes()
+        assert cache.get("k", lambda: state["value"]) == {"new"}
+        assert cache.stats.refreshes == 1
+
+    def test_failed_refresh_keeps_stale_value(self):
+        clock = FakeClock()
+        cache = ServingEventCache(refresh_interval=1.0, clock=clock)
+
+        def boom():
+            raise RuntimeError("storage down")
+
+        cache.get("k", lambda: {"v1"})
+        clock.now += 5
+        assert cache.get("k", boom) == {"v1"}
+        cache.wait_refreshes()
+        assert cache.get("k", boom) == {"v1"}  # still serving stale
+
+    def test_eviction_bounds_entries(self):
+        clock = FakeClock()
+        cache = ServingEventCache(refresh_interval=60, max_entries=3, clock=clock)
+        for i in range(5):
+            clock.now += 1
+            cache.get(f"k{i}", lambda i=i: i)
+        assert len(cache) == 3
+        assert cache.stats.evictions == 2
+        # oldest entries were dropped; newest remain
+        assert cache.get("k4", lambda: "reload") == 4
+
+    def test_hung_refresh_does_not_block_future_refreshes(self):
+        # a loader stuck in a TCP black hole must not freeze the cache:
+        # after refresh_timeout a new refresh may run, and the hung one —
+        # if it ever completes — loses the write race
+        clock = FakeClock()
+        cache = ServingEventCache(
+            refresh_interval=1.0, refresh_timeout=0.05, clock=clock
+        )
+        import threading
+
+        release = threading.Event()
+
+        def hung_loader():
+            release.wait(5)
+            return {"from-hung"}
+
+        cache.get("k", lambda: {"v1"})
+        clock.now += 5
+        cache.get("k", hung_loader)  # schedules the refresh that hangs
+        time.sleep(0.1)  # > refresh_timeout: the hung entry is presumed dead
+        clock.now += 5
+        assert cache.get("k", lambda: {"v2"}) == {"v1"}  # schedules fresh one
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            if cache.get("k", lambda: {"v2"}) == {"v2"}:
+                break
+            time.sleep(0.01)
+        assert cache.get("k", lambda: {"v2"}) == {"v2"}
+        release.set()  # hung loader finally returns...
+        time.sleep(0.1)
+        assert cache.get("k", lambda: {"v2"}) == {"v2"}  # ...and cannot clobber
+
+    def test_refresh_deduplicates_inflight(self):
+        clock = FakeClock()
+        cache = ServingEventCache(refresh_interval=1.0, clock=clock)
+        loads = []
+
+        def slow_load():
+            loads.append(1)
+            time.sleep(0.05)
+            return len(loads)
+
+        cache.get("k", slow_load)
+        clock.now += 5
+        for _ in range(10):  # ten stale hits while one refresh is in flight
+            cache.get("k", slow_load)
+        cache.wait_refreshes()
+        assert len(loads) == 2  # initial load + exactly one refresh
+
+
+class TestECommerceServingCache:
+    """The template's filtered predict path over the cache."""
+
+    def seed(self, le, app_id):
+        rng = np.random.default_rng(9)
+        for u in range(20):
+            for i in rng.choice(12, size=4, replace=False):
+                le.insert(
+                    Event(
+                        event="view",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                    ),
+                    app_id,
+                )
+
+    def make(self, ctx, clock):
+        from predictionio_tpu.templates.ecommerce import ECommerceEngine
+
+        engine = ECommerceEngine.apply()
+        ep = engine.params_from_variant(
+            {
+                "datasource": {"params": {"appName": "tapp"}},
+                "algorithms": [
+                    {
+                        "name": "ecomm",
+                        "params": {
+                            "appName": "tapp",
+                            "rank": 4,
+                            "numIterations": 4,
+                            "unseenOnly": True,
+                            "cacheRefreshSeconds": 5,
+                        },
+                    }
+                ],
+            }
+        )
+        models = engine.train(ctx, ep)
+        algo = engine.make_algorithms(ep)[0]
+        # deterministic clock for the TTL logic
+        algo._event_cache = ServingEventCache(refresh_interval=5.0, clock=clock)
+        return algo, models[0]
+
+    def test_steady_state_makes_zero_storage_reads(self, app, ctx, monkeypatch):
+        from predictionio_tpu.data.store import LEventStore
+        from predictionio_tpu.templates.ecommerce import Query
+
+        self.seed(app["le"], app["app_id"])
+        clock = FakeClock()
+        algo, model = self.make(ctx, clock)
+
+        reads = []
+        orig = LEventStore.find_by_entity
+
+        def counting(*args, **kwargs):
+            reads.append(kwargs.get("entity_id") or (args and args[0]))
+            return orig(*args, **kwargs)
+
+        monkeypatch.setattr(LEventStore, "find_by_entity", staticmethod(counting))
+        algo.predict(model, Query(user="u0", num=3))
+        warm = len(reads)  # first query pays the storage reads
+        assert warm >= 1
+        for _ in range(20):
+            algo.predict(model, Query(user="u0", num=3))
+        assert len(reads) == warm  # steady state: ZERO further round-trips
+
+    def test_new_events_appear_after_refresh(self, app, ctx):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        self.seed(app["le"], app["app_id"])
+        clock = FakeClock()
+        algo, model = self.make(ctx, clock)
+
+        res = algo.predict(model, Query(user="u0", num=3))
+        top = res.itemScores[0].item
+        # the user now views the top item; unseenOnly must exclude it —
+        # but only after the refresh interval elapses
+        app["le"].insert(
+            Event(
+                event="view",
+                entity_type="user",
+                entity_id="u0",
+                target_entity_type="item",
+                target_entity_id=top,
+            ),
+            app["app_id"],
+        )
+        res2 = algo.predict(model, Query(user="u0", num=3))
+        assert res2.itemScores[0].item == top  # cached seen-set: still served
+        clock.now += 10  # TTL elapses → async refresh scheduled by next hit
+        algo.predict(model, Query(user="u0", num=3))
+        algo._event_cache.wait_refreshes()
+        res3 = algo.predict(model, Query(user="u0", num=3))
+        assert top not in {s.item for s in res3.itemScores}

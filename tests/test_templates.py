@@ -552,7 +552,7 @@ class TestECommerceTemplate:
                 app_id,
             )
 
-    def make(self, ctx, unseen_only=False):
+    def make(self, ctx, unseen_only=False, **extra):
         from predictionio_tpu.templates.ecommerce import ECommerceEngine
 
         engine = ECommerceEngine.apply()
@@ -567,6 +567,10 @@ class TestECommerceTemplate:
                             "rank": 6,
                             "numIterations": 6,
                             "unseenOnly": unseen_only,
+                            # most tests assert IMMEDIATE event visibility;
+                            # cache behavior has its own tests below
+                            "cacheRefreshSeconds": 0,
+                            **extra,
                         },
                     }
                 ],

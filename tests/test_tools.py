@@ -255,6 +255,28 @@ class TestAdminServer:
             server.stop()
 
 
+class TestCliEval:
+    def test_output_best_writes_best_json(self, cli_env, capsys, tmp_path):
+        """`pio eval --output-best` writes the best-params JSON (parity:
+        MetricEvaluator.saveEngineJson, MetricEvaluator.scala:193)."""
+        best = tmp_path / "best.json"
+        assert (
+            run_cli(
+                "eval", "test_evaluation.SampleEvaluation",
+                "--output-best", str(best),
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert f"Best engine params written to {best}" in out
+        # per-candidate metric columns surface in the summary table
+        assert "candidates:" in out and "| params" in out
+        data = json.loads(best.read_text())
+        assert data["bestScore"] == 7.0
+        assert "bestEngineParams" in data
+        assert len(data["results"]) >= 1
+
+
 class TestDashboard:
     def test_lists_completed_evaluations(self, storage):
         from predictionio_tpu.core.evaluation import run_evaluation
