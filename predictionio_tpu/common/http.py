@@ -38,7 +38,10 @@ class Request:
 @dataclass
 class Response:
     status: int = 200
-    body: Any = None  # JSON-serializable, or str (text/html), or bytes
+    # JSON-serializable, str (text/html), bytes, or an ITERATOR of bytes —
+    # iterators are sent with Transfer-Encoding: chunked, one HTTP chunk per
+    # yielded piece, so multi-GB bulk pulls never materialize one body buffer
+    body: Any = None
     content_type: Optional[str] = None
     headers: dict[str, str] = field(default_factory=dict)
 
@@ -132,6 +135,22 @@ class HttpService:
             def _send(self, resp: Response):
                 body = resp.body
                 ctype = resp.content_type
+                if hasattr(body, "__next__"):  # byte-iterator → chunked
+                    self.send_response(resp.status)
+                    self.send_header(
+                        "Content-Type", ctype or "application/octet-stream"
+                    )
+                    self.send_header("Transfer-Encoding", "chunked")
+                    for k, v in resp.headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    for piece in body:
+                        if piece:
+                            self.wfile.write(
+                                f"{len(piece):x}\r\n".encode() + piece + b"\r\n"
+                            )
+                    self.wfile.write(b"0\r\n\r\n")
+                    return
                 if isinstance(body, bytes):
                     payload = body
                     ctype = ctype or "application/octet-stream"

@@ -156,6 +156,48 @@ def batch_to_npz(batch: EventBatch) -> bytes:
     return buf.getvalue()
 
 
+def _slice_batch(b: EventBatch, s: int, e: int) -> EventBatch:
+    """Row-range view (copies) for chunked wire transfer."""
+    return EventBatch(
+        event=b.event[s:e],
+        entity_type=b.entity_type[s:e],
+        entity_id=b.entity_id[s:e],
+        target_entity_type=b.target_entity_type[s:e],
+        target_entity_id=b.target_entity_id[s:e],
+        event_time=b.event_time[s:e],
+        properties=list(b.properties[s:e]),
+        event_id=b.event_id[s:e],
+        tags=list(b.tags[s:e]),
+        pr_id=b.pr_id[s:e],
+        creation_time=b.creation_time[s:e],
+    )
+
+
+def _concat_batches(parts: list[EventBatch]) -> EventBatch:
+    if len(parts) == 1:
+        return parts[0]
+    return EventBatch(
+        event=np.concatenate([p.event for p in parts]),
+        entity_type=np.concatenate([p.entity_type for p in parts]),
+        entity_id=np.concatenate([p.entity_id for p in parts]),
+        target_entity_type=np.concatenate([p.target_entity_type for p in parts]),
+        target_entity_id=np.concatenate([p.target_entity_id for p in parts]),
+        event_time=np.concatenate([p.event_time for p in parts]),
+        properties=[d for p in parts for d in p.properties],
+        event_id=np.concatenate([p.event_id for p in parts]),
+        tags=[t for p in parts for t in p.tags],
+        pr_id=np.concatenate([p.pr_id for p in parts]),
+        creation_time=np.concatenate([p.creation_time for p in parts]),
+    )
+
+
+# Content type marking a framed stream: 8-byte big-endian length prefix per
+# npz frame. Framing is ours (not HTTP chunk boundaries) so proxies that
+# re-chunk the transfer can't corrupt it, and an old server that ignores
+# chunk_rows still interoperates (client falls back on the content type).
+FRAMES_CONTENT_TYPE = "application/x-pio-frames"
+
+
 def batch_from_npz(data: bytes) -> EventBatch:
     z = np.load(io.BytesIO(data), allow_pickle=False)
 
@@ -330,9 +372,32 @@ class StorageServer:
         @svc.route("POST", r"/pevents/find")
         @guarded
         def pevents_find(req: Request):
-            args = _find_kwargs_from_wire(req.json() or {})
+            raw = req.json() or {}
+            # chunked bulk pull (HBase bulk-scan role, HBEventsUtil.scala:
+            # 83-135): the body streams as length-prefixed npz frames of
+            # chunk_rows events each, so neither side ever holds one
+            # multi-GB buffer and per-read timeouts replace a whole-body
+            # deadline
+            chunk_rows = int(raw.pop("chunk_rows", 0) or 0)
+            args = _find_kwargs_from_wire(raw)
             app_id = int(args.pop("app_id"))
             batch = self.storage.get_p_events().find(app_id, **args)
+            if chunk_rows > 0:
+                n = len(batch)
+                # first frame built EAGERLY: serialization errors (bad
+                # property values etc.) still surface as a guarded 400,
+                # not a half-sent 200 with truncated frames
+                first = batch_to_npz(_slice_batch(batch, 0, min(chunk_rows, n)))
+
+                def frames():
+                    yield len(first).to_bytes(8, "big") + first
+                    for s in range(chunk_rows, n, chunk_rows):
+                        payload = batch_to_npz(
+                            _slice_batch(batch, s, min(s + chunk_rows, n))
+                        )
+                        yield len(payload).to_bytes(8, "big") + payload
+
+                return Response(200, frames(), content_type=FRAMES_CONTENT_TYPE)
             return Response(
                 200, batch_to_npz(batch), content_type="application/octet-stream"
             )
@@ -558,7 +623,8 @@ class _Client:
     """Shared HTTP plumbing for all network DAOs of one source."""
 
     def __init__(self, source_name: str = "default", url: Optional[str] = None,
-                 secret: Optional[str] = None, timeout: float = 60.0):
+                 secret: Optional[str] = None, timeout: float = 60.0,
+                 chunk_rows: int = 200_000):
         if not url:
             raise NetworkStorageError(
                 f"network storage source {source_name!r} needs "
@@ -566,10 +632,15 @@ class _Client:
             )
         self.url = url.rstrip("/")
         self.secret = secret
+        # PIO_STORAGE_SOURCES_<N>_TIMEOUT: per-socket-read seconds (chunked
+        # pulls reset it per frame); _CHUNK_ROWS: frame size for bulk
+        # scans, 0 = single-body (legacy) wire
         self.timeout = float(timeout)
+        self.chunk_rows = int(chunk_rows)
 
-    def _request(self, method: str, path: str, body: Optional[bytes],
-                 content_type: str) -> tuple[bytes, str]:
+    def _open(self, method: str, path: str, body: Optional[bytes],
+              content_type: str):
+        """Open the HTTP call; shared error mapping for body+stream paths."""
         headers = {"Content-Type": content_type}
         if self.secret:
             headers[SECRET_HEADER] = self.secret
@@ -577,8 +648,7 @@ class _Client:
             self.url + path, data=body, method=method, headers=headers
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                return r.read(), r.headers.get("Content-Type", "")
+            return urllib.request.urlopen(req, timeout=self.timeout)
         except urllib.error.HTTPError as e:
             try:
                 msg = json.loads(e.read().decode()).get("message", str(e))
@@ -591,6 +661,11 @@ class _Client:
             raise NetworkStorageError(
                 f"storage server unreachable at {self.url}: {e.reason}"
             ) from None
+
+    def _request(self, method: str, path: str, body: Optional[bytes],
+                 content_type: str) -> tuple[bytes, str]:
+        with self._open(method, path, body, content_type) as r:
+            return r.read(), r.headers.get("Content-Type", "")
 
     def call(self, path: str, args: dict) -> Any:
         payload, _ = self._request(
@@ -610,6 +685,41 @@ class _Client:
             "POST", path + qs, data, "application/octet-stream"
         )
         return json.loads(payload.decode())["result"]
+
+    def iter_frames(self, path: str, args: dict):
+        """POST and yield npz frames incrementally from a framed stream.
+
+        Reads never buffer more than one frame; the socket timeout applies
+        per read, so a 25M-event pull can't trip a whole-body deadline.
+        Falls back to yielding the whole body once when the server answers
+        with a plain (unframed) payload.
+        """
+        r = self._open(path=path, method="POST",
+                       body=json.dumps(args).encode(),
+                       content_type="application/json")
+        with r:
+            if FRAMES_CONTENT_TYPE not in (r.headers.get("Content-Type") or ""):
+                yield r.read()  # unframed server: one body
+                return
+
+            def read_exact(n: int, eof_ok: bool = False) -> Optional[bytes]:
+                buf = bytearray()
+                while len(buf) < n:
+                    piece = r.read(n - len(buf))
+                    if not piece:
+                        if eof_ok and not buf:
+                            return None
+                        raise NetworkStorageError(
+                            f"{path}: truncated frame stream"
+                        )
+                    buf.extend(piece)
+                return bytes(buf)
+
+            while True:
+                header = read_exact(8, eof_ok=True)
+                if header is None:
+                    return
+                yield read_exact(int.from_bytes(header, "big"))
 
     def get_binary(self, path: str) -> Optional[bytes]:
         try:
@@ -685,6 +795,23 @@ class NetworkPEvents(base.PEvents):
         wire["app_id"] = app_id
         if channel_id is not None:
             wire["channel_id"] = channel_id
+        if self._c.chunk_rows > 0:
+            chunked = dict(wire, chunk_rows=self._c.chunk_rows)
+            try:
+                parts = [
+                    batch_from_npz(frame)
+                    for frame in self._c.iter_frames("/pevents/find", chunked)
+                ]
+                return _concat_batches(parts)
+            except NetworkStorageError as e:
+                # a pre-framing server passes chunk_rows into its backing
+                # DAO and 400s; retry once on the legacy single-body wire
+                # so rolling upgrades don't break bulk reads
+                if "chunk_rows" not in str(e):
+                    raise
+                logger.info(
+                    "server rejected chunk_rows (%s); using single-body wire", e
+                )
         return batch_from_npz(self._c.call_binary("/pevents/find", wire))
 
     def find_interactions(self, app_id, channel_id=None, entity_type=None,

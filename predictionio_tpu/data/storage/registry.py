@@ -68,6 +68,11 @@ def _register_builtin():
     }
     register_driver("sqlite", sqlite_daos)
     register_driver("localfs", {"Models": localfs.LocalFSModels})
+    from predictionio_tpu.data.storage import s3
+
+    # S3-compatible MODELDATA (parity: storage/s3 S3Models.scala); works
+    # against AWS/MinIO/localstack or the in-repo s3stub
+    register_driver("s3", {"Models": s3.S3Models})
     from predictionio_tpu.data.storage import network
 
     register_driver(

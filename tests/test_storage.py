@@ -31,7 +31,7 @@ def ev(event, eid, t=0, target=None, props=None):
     )
 
 
-@pytest.fixture(params=["memory", "sqlite", "parquet", "network"])
+@pytest.fixture(params=["memory", "sqlite", "parquet", "network", "s3"])
 def driver_env(request, tmp_path):
     name = "T" + uuid.uuid4().hex[:8].upper()
     env = {
@@ -41,7 +41,24 @@ def driver_env(request, tmp_path):
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": name,
     }
     server = None
-    if request.param == "sqlite":
+    if request.param == "s3":
+        # s3 implements MODELDATA only (reference parity: S3Models.scala);
+        # the matrix pairing mirrors run_docker.sh's MODEL=S3 rows. The
+        # stub plays localstack and verifies SigV4 for real.
+        from predictionio_tpu.data.storage.s3stub import S3Stub
+
+        server = S3Stub(access_key="pio-test", secret_key="pio-secret")
+        port = server.start("127.0.0.1", 0)
+        env[f"PIO_STORAGE_SOURCES_{name}_TYPE"] = "memory"
+        env.update({
+            f"PIO_STORAGE_SOURCES_{name}S3_TYPE": "s3",
+            f"PIO_STORAGE_SOURCES_{name}S3_ENDPOINT": f"http://127.0.0.1:{port}",
+            f"PIO_STORAGE_SOURCES_{name}S3_BUCKET": "pio-models",
+            f"PIO_STORAGE_SOURCES_{name}S3_ACCESS_KEY": "pio-test",
+            f"PIO_STORAGE_SOURCES_{name}S3_SECRET_KEY": "pio-secret",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": name + "S3",
+        })
+    elif request.param == "sqlite":
         env[f"PIO_STORAGE_SOURCES_{name}_PATH"] = str(tmp_path / "pio.sqlite")
     elif request.param == "parquet":
         # parquet implements EVENTDATA only; meta/model repos use memory
