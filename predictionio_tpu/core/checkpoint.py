@@ -39,10 +39,17 @@ def _checkpointer():
 
 
 def save_pytree(path: str, tree: Any) -> None:
-    """Persist a pytree of (device or host) arrays at ``path``."""
+    """Persist a pytree of (device or host) arrays at ``path``.
+
+    Multi-host: device_get_global all-gathers process-spanning shards —
+    a COLLECTIVE, so when leaves are sharded across processes this must
+    be called from every process (gather on all, write where called).
+    """
     import jax
 
-    host_tree = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), tree)
+    from predictionio_tpu.parallel.mesh import device_get_global
+
+    host_tree = jax.tree.map(device_get_global, tree)
     _checkpointer().save(os.path.abspath(path), host_tree, force=True)
 
 

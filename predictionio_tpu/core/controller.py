@@ -143,9 +143,13 @@ class ShardedAlgorithm(Algorithm[PD, M, Q, P]):
 
     def make_serializable_model(self, model: M) -> Any:
         import jax
-        import numpy as np
 
-        return jax.tree.map(lambda a: np.asarray(jax.device_get(a)), model)
+        from predictionio_tpu.parallel.mesh import device_get_global
+
+        # multi-host: the all-gather under device_get_global is a
+        # collective — run_train therefore gathers on EVERY process and
+        # gates only the storage write to the coordinator
+        return jax.tree.map(device_get_global, model)
 
     def load_serializable_model(self, ctx, blob: Any) -> M:
         return self.place_model(ctx, blob)

@@ -66,9 +66,18 @@ def serialize_models(
     Each slot is one of ``("pickle", blob)``, ``("manifest", class_path)`` or
     ``("retrain", None)``.
     """
+    from predictionio_tpu.parallel import distributed
+
     slots = []
     for algo, model, params in zip(algorithms, models, algo_params):
         if isinstance(model, PersistentModel):
+            # multi-host: only the coordinator performs the manifest-mode
+            # file write; other processes emit the same (host-form) slot
+            # without side effects. PersistentModel models are host-form
+            # by contract, so skipping save() here is not a collective.
+            if not distributed.should_write_storage():
+                slots.append(("manifest", class_path(model)))
+                continue
             if model.save(instance_id, params):
                 slots.append(("manifest", class_path(model)))
             else:

@@ -102,6 +102,14 @@ def run_train(
     ctx = ctx or MeshContext.create()
     wp = workflow_params or WorkflowParams()
 
+    # multi-host SPMD: every process trains (reads events, joins the
+    # collectives), but ONLY the coordinator writes meta/model rows — the
+    # reference has one Spark driver doing these writes; process 0 plays
+    # that role here (parallel/distributed.py launch contract).
+    from predictionio_tpu.parallel import distributed
+
+    writer = distributed.should_write_storage()
+
     instances = storage.get_meta_data_engine_instances()
     now = _dt.datetime.now(tz=UTC)
     instance = EngineInstance(
@@ -118,11 +126,12 @@ def run_train(
         mesh_conf=dict(ctx.conf),
         **engine_params.to_json_strings(),
     )
-    instance_id = instances.insert(instance)
-    logger.info("engine instance %s: training started", instance_id)
-
-    instance.status = instances.STATUS_TRAINING
-    instances.update(instance)
+    instance_id = ""
+    if writer:
+        instance_id = instances.insert(instance)
+        logger.info("engine instance %s: training started", instance_id)
+        instance.status = instances.STATUS_TRAINING
+        instances.update(instance)
 
     try:
         algorithms = engine.make_algorithms(engine_params)
@@ -135,24 +144,34 @@ def run_train(
             algorithms=algorithms,
         )
 
+        # serialize on EVERY process: gathering a cross-process sharded
+        # model is a collective (device_get_global), so all processes must
+        # participate; only the coordinator then inserts the blob.
+        # (PersistentModel.save file writes inside serialize_models are
+        # writer-gated there.)
         algo_params = [p for _, p in engine_params.algorithm_params_list]
         blob = persistence.serialize_models(
             instance_id, algorithms, models, algo_params
         )
-        storage.get_model_data_models().insert(Model(id=instance_id, models=blob))
+        if writer:
+            storage.get_model_data_models().insert(
+                Model(id=instance_id, models=blob)
+            )
     except BaseException:
         # no zombie TRAINING rows: mark the run aborted, then propagate
-        instance.status = instances.STATUS_ABORTED
-        instance.end_time = _dt.datetime.now(tz=UTC)
-        instances.update(instance)
+        if writer:
+            instance.status = instances.STATUS_ABORTED
+            instance.end_time = _dt.datetime.now(tz=UTC)
+            instances.update(instance)
         raise
     finally:
         CleanupFunctions.run()
 
-    instance.status = instances.STATUS_COMPLETED
-    instance.end_time = _dt.datetime.now(tz=UTC)
-    instances.update(instance)
-    logger.info("engine instance %s: training completed", instance_id)
+    if writer:
+        instance.status = instances.STATUS_COMPLETED
+        instance.end_time = _dt.datetime.now(tz=UTC)
+        instances.update(instance)
+        logger.info("engine instance %s: training completed", instance_id)
     return instance_id
 
 

@@ -42,7 +42,12 @@ from jax import shard_map
 from predictionio_tpu.data.batch import Interactions
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.ops.segment import segment_sum
-from predictionio_tpu.parallel.mesh import DATA_AXIS, MeshContext, pad_to_multiple
+from predictionio_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MeshContext,
+    device_get_global,
+    pad_to_multiple,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -400,11 +405,21 @@ def train_als(
         if manager is not None and save_due(
             it + 1, cfg.checkpoint_interval, cfg.iterations
         ):
-            manager.save(
-                it + 1, {"U": U, "V": V, "fingerprint": fingerprint}
-            )
-    U_all = np.asarray(jax.device_get(U))
-    V_all = np.asarray(jax.device_get(V))
+            # gather on ALL processes (collective), write on the
+            # coordinator only — a shared checkpoint_dir must not take
+            # concurrent writers; resume requires it be shared across
+            # hosts (docs/operations.md multi-host section)
+            state = {
+                "U": device_get_global(U),
+                "V": device_get_global(V),
+                "fingerprint": fingerprint,
+            }
+            from predictionio_tpu.parallel import distributed
+
+            if distributed.should_write_storage():
+                manager.save(it + 1, state)
+    U_all = device_get_global(U)
+    V_all = device_get_global(V)
     # factor row new_id belongs to old entity id o with perm[o] == new_id;
     # return in original id order so the model is permutation-invisible
     U_host = U_all[u_perm[:n_users]] if u_perm is not None else U_all[:n_users]

@@ -513,14 +513,19 @@ def train_sasrec(
         if manager is not None and save_due(
             epoch + 1, cfg.checkpoint_interval, cfg.epochs
         ):
-            manager.save(
-                epoch + 1,
+            # gather on ALL processes (ctx.to_host all-gathers spanning
+            # shards — a collective), write on the coordinator only
+            state = ctx.to_host(
                 {
                     "params": params,
                     "opt": jax.tree.leaves(opt_state),
                     "fingerprint": fingerprint,
-                },
+                }
             )
+            from predictionio_tpu.parallel import distributed
+
+            if distributed.should_write_storage():
+                manager.save(epoch + 1, state)
     return SASRecModel(
         params=ctx.to_host(params), item_map=interactions.item_map, config=cfg
     )

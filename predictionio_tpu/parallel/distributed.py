@@ -68,6 +68,10 @@ def initialize(
     return True
 
 
+def is_initialized() -> bool:
+    return _initialized
+
+
 def process_index() -> int:
     import jax
 
@@ -76,3 +80,15 @@ def process_index() -> int:
 
 def is_coordinator() -> bool:
     return process_index() == 0
+
+
+def should_write_storage() -> bool:
+    """True when THIS process owns meta/model writes.
+
+    Under the SPMD launch contract every host runs the same workflow; all
+    of them read events and participate in collectives, but exactly one
+    (the coordinator) records EngineInstances and model blobs — otherwise
+    an N-host train would insert N instances (the reference has one Spark
+    driver doing these writes; here process 0 plays that role).
+    """
+    return not _initialized or is_coordinator()

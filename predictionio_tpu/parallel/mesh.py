@@ -157,7 +157,23 @@ class MeshContext:
 
     def to_host(self, tree):
         """Device pytree → host numpy pytree (for persistence)."""
-        return jax.tree.map(lambda a: np.asarray(jax.device_get(a)), tree)
+        return jax.tree.map(device_get_global, tree)
+
+
+def device_get_global(x) -> np.ndarray:
+    """Device→host that works when the array spans multiple PROCESSES.
+
+    Single-process: a plain ``device_get``.  Multi-host SPMD: a sharded
+    array's remote shards are non-addressable, so every process
+    all-gathers the global value (``process_allgather`` — rides the same
+    collective fabric as training).  Every process returns the full array.
+    """
+    if jax.process_count() > 1 and hasattr(x, "sharding"):
+        from jax.experimental import multihost_utils
+
+        if not getattr(x.sharding, "is_fully_addressable", True):
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(jax.device_get(x))
 
 
 def default_context(conf: Optional[dict] = None) -> MeshContext:

@@ -249,6 +249,35 @@ def cmd_accesskey(args) -> int:
     return _die(f"unknown accesskey command {args.ak_command}")
 
 
+def cmd_launch(args) -> int:
+    """Multi-host/process launch (Runner.runOnSpark role, Runner.scala:185)."""
+    from predictionio_tpu.tools import launcher
+
+    pio_args = list(args.pio_args)
+    if pio_args and pio_args[0] == "--":
+        pio_args = pio_args[1:]
+    if not pio_args:
+        print("[ERROR] launch needs a pio command after --", file=sys.stderr)
+        return 1
+    if args.hosts:
+        hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
+        for line in launcher.render_host_commands(
+            pio_args, hosts, args.coordinator_port
+        ):
+            print(line)
+        return 0
+    rc = launcher.launch_local(
+        pio_args,
+        num_processes=args.num_processes,
+        coordinator_port=args.coordinator_port,
+    )
+    if rc == 0:
+        print(f"[INFO] all {args.num_processes} processes completed")
+    else:
+        print(f"[ERROR] a worker failed (exit {rc})", file=sys.stderr)
+    return rc
+
+
 def cmd_train(args) -> int:
     from predictionio_tpu.core.workflow import WorkflowParams, run_train
 
@@ -596,6 +625,26 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--stop-after-prepare", action="store_true")
     sp.set_defaults(func=cmd_train)
 
+    sp = sub.add_parser(
+        "launch",
+        help="run a pio command as N coordinated processes (multi-host "
+        "SPMD launch contract; Runner.runOnSpark role)",
+    )
+    sp.add_argument("--num-processes", type=int, default=2)
+    sp.add_argument("--coordinator-port", type=int, default=7654)
+    sp.add_argument(
+        "--hosts",
+        default=None,
+        help="comma-separated host list: print per-host command lines "
+        "instead of spawning locally (hosts[0] is the coordinator)",
+    )
+    sp.add_argument(
+        "pio_args",
+        nargs=argparse.REMAINDER,
+        help="the pio command to launch, after --  (e.g. -- train)",
+    )
+    sp.set_defaults(func=cmd_launch)
+
     sp = sub.add_parser("eval")
     sp.add_argument("evaluation_class")
     sp.add_argument("engine_params_generator_class", nargs="?", default=None)
@@ -718,6 +767,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         from predictionio_tpu.parallel.mesh import pin_platform_from_env
 
         pin_platform_from_env()
+    if os.environ.get("PIO_COORDINATOR"):
+        # the multi-host contract requires jax.distributed.initialize()
+        # before ANY backend-initializing jax call; engine/template imports
+        # can touch the backend, so join the rendezvous first
+        from predictionio_tpu.parallel import distributed
+
+        distributed.initialize()
     try:
         return args.func(args)
     except (FileNotFoundError, ValueError, RuntimeError) as e:

@@ -1,0 +1,113 @@
+"""Multi-host launch orchestration — the ``Runner.runOnSpark`` role.
+
+The reference CLI never runs workloads in-process: it builds a
+``spark-submit`` argv and lets Spark place executors across the cluster
+(``tools/src/main/scala/org/apache/predictionio/tools/Runner.scala:185-334``).
+The TPU-native equivalent has no cluster manager in the middle — one
+process per host runs the SAME program under the ``jax.distributed``
+SPMD contract (``parallel/distributed.py``):
+
+    PIO_COORDINATOR=host0:port PIO_NUM_PROCESSES=N PIO_PROCESS_ID=i pio <verb>
+
+``pio launch`` materializes that contract two ways:
+
+* **local mode** (default): spawn all N processes on this machine —
+  exercising real cross-process collectives (the Spark ``local[N]`` role,
+  and exactly how a single multi-chip host runs).
+* **--hosts h0,h1,...**: print the per-host command lines (host 0 is the
+  coordinator) for the operator's parallel-ssh tooling; this image has no
+  ssh, and the reference similarly delegates placement (to Spark).
+
+Every line of a worker's output is prefixed ``[p<i>] `` so interleaved
+logs stay attributable; exit status is the worst worker's.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+import threading
+from typing import Optional, Sequence
+
+WORKER_PREFIX = "[p{index}] "
+
+
+def worker_env(
+    base_env: dict,
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+) -> dict:
+    env = dict(base_env)
+    env.update(
+        {
+            "PIO_COORDINATOR": coordinator,
+            "PIO_NUM_PROCESSES": str(num_processes),
+            "PIO_PROCESS_ID": str(process_id),
+        }
+    )
+    return env
+
+
+def _pump(proc: subprocess.Popen, index: int, out) -> None:
+    prefix = WORKER_PREFIX.format(index=index)
+    for line in proc.stdout:
+        out.write(prefix + line)
+        out.flush()
+
+
+def launch_local(
+    pio_args: Sequence[str],
+    num_processes: int,
+    coordinator_port: int,
+    env: Optional[dict] = None,
+    out=None,
+) -> int:
+    """Run ``pio <pio_args>`` as N coordinated local processes.
+
+    Returns the maximum worker exit code (0 iff all succeeded). A worker
+    that dies takes the rendezvous with it, so the rest exit too rather
+    than hanging forever — jax.distributed's barrier sees the drop.
+    """
+    out = out or sys.stdout
+    base = dict(env if env is not None else os.environ)
+    coordinator = f"127.0.0.1:{coordinator_port}"
+    procs: list[subprocess.Popen] = []
+    pumps: list[threading.Thread] = []
+    for i in range(num_processes):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.tools.cli", *pio_args],
+            env=worker_env(base, coordinator, num_processes, i),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        procs.append(p)
+        t = threading.Thread(target=_pump, args=(p, i, out), daemon=True)
+        t.start()
+        pumps.append(t)
+    rcs = [p.wait() for p in procs]
+    for t in pumps:
+        t.join(timeout=5)
+    return max(rcs)
+
+
+def render_host_commands(
+    pio_args: Sequence[str],
+    hosts: Sequence[str],
+    coordinator_port: int,
+) -> list[str]:
+    """Per-host command lines; hosts[0] is the coordinator."""
+    coordinator = f"{hosts[0]}:{coordinator_port}"
+    quoted = " ".join(shlex.quote(a) for a in pio_args)
+    lines = []
+    for i, host in enumerate(hosts):
+        lines.append(
+            f"# on {host}:\n"
+            f"PIO_COORDINATOR={coordinator} "
+            f"PIO_NUM_PROCESSES={len(hosts)} "
+            f"PIO_PROCESS_ID={i} pio {quoted}"
+        )
+    return lines
