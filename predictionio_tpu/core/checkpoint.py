@@ -16,6 +16,7 @@ step state" on top of the reference's three deploy-time persistence modes
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import re
@@ -24,6 +25,23 @@ from typing import Any, Optional
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+
+def dataset_digest(*arrays) -> int:
+    """Order-sensitive dataset digest for checkpoint fingerprints.
+
+    sha1 over the raw bytes of each array in sequence (incremental — no
+    concatenated copy of a multi-GB dataset), truncated to 48 bits so the
+    value stays exact inside the float64 fingerprint arrays the trainers
+    build. Permutation-sensitive by construction: element sums are not
+    (a reordered/relabeled dataset must NOT resume a foreign checkpoint).
+    """
+    h = hashlib.sha1()
+    for a in arrays:
+        # .data is a zero-copy memoryview; tobytes() would transiently
+        # double memory per array on multi-GB datasets
+        h.update(np.ascontiguousarray(a).data)
+    return int(h.hexdigest()[:12], 16)
 
 
 _CHECKPOINTER = None

@@ -197,6 +197,11 @@ def _concat_batches(parts: list[EventBatch]) -> EventBatch:
 # chunk_rows still interoperates (client falls back on the content type).
 FRAMES_CONTENT_TYPE = "application/x-pio-frames"
 
+# Wire features this server build speaks, advertised on ``GET /``. Clients
+# consult the list before choosing a format — a pre-capability server simply
+# has no list, which reads as "legacy wire only" with no error-text sniffing.
+SERVER_CAPABILITIES = frozenset({"framed_scan"})
+
 
 def batch_from_npz(data: bytes) -> EventBatch:
     z = np.load(io.BytesIO(data), allow_pickle=False)
@@ -313,8 +318,14 @@ class StorageServer:
 
         @svc.route("GET", r"/")
         def index(req: Request):
-            # health probe stays open; topology detail is for authed peers
-            info = {"status": "alive", "service": "pio-storage-server"}
+            # health probe stays open; topology detail is for authed peers.
+            # capabilities is protocol metadata, not topology: clients use it
+            # to pick wire formats structurally instead of sniffing error text
+            info = {
+                "status": "alive",
+                "service": "pio-storage-server",
+                "capabilities": sorted(SERVER_CAPABILITIES),
+            }
             if server._auth_ok(req):
                 info["repositories"] = {
                     repo: {"source": src, "type": typ}
@@ -616,7 +627,12 @@ _META_HANDLERS = {
 
 
 class NetworkStorageError(Exception):
-    pass
+    """Storage-wire failure; ``status`` carries the HTTP code (or None for
+    transport errors) so callers can branch structurally, never on text."""
+
+    def __init__(self, message: str, status: Optional[int] = None):
+        super().__init__(message)
+        self.status = status
 
 
 class _Client:
@@ -637,6 +653,31 @@ class _Client:
         # scans, 0 = single-body (legacy) wire
         self.timeout = float(timeout)
         self.chunk_rows = int(chunk_rows)
+        self._caps: Optional[frozenset] = None
+
+    def capabilities(self) -> frozenset:
+        """Wire features the server advertises on ``GET /`` (cached).
+
+        A pre-capability server returns no ``capabilities`` field — the
+        caller falls back to the legacy wire structurally, never by matching
+        error text (rolling-upgrade contract). Only non-empty capability
+        sets are cached: a failed probe (server restarting) or a legacy
+        answer (mixed fleet mid-upgrade) yields "none" for THIS call but
+        re-probes on the next, so a long-lived client is never permanently
+        downgraded to the single-body wire. Bulk scans are heavy and rare;
+        one extra GET per scan against a legacy server is noise.
+        """
+        if self._caps is None:
+            try:
+                payload, _ = self._request("GET", "/", None, "application/json")
+                info = json.loads(payload.decode())
+                caps = frozenset(info.get("capabilities") or ())
+            except Exception:
+                return frozenset()
+            if not caps:
+                return caps
+            self._caps = caps
+        return self._caps
 
     def _open(self, method: str, path: str, body: Optional[bytes],
               content_type: str):
@@ -656,7 +697,7 @@ class _Client:
                 msg = str(e)
             if e.code == 404 and "not found" in msg:
                 raise FileNotFoundError(msg) from None
-            raise NetworkStorageError(f"{path}: {msg}") from None
+            raise NetworkStorageError(f"{path}: {msg}", status=e.code) from None
         except urllib.error.URLError as e:
             raise NetworkStorageError(
                 f"storage server unreachable at {self.url}: {e.reason}"
@@ -795,7 +836,11 @@ class NetworkPEvents(base.PEvents):
         wire["app_id"] = app_id
         if channel_id is not None:
             wire["channel_id"] = channel_id
-        if self._c.chunk_rows > 0:
+        # framed bulk pull only when the server advertises it (GET /
+        # capabilities); a pre-framing server would pass chunk_rows into its
+        # backing DAO and 400, so the capability gate — not error-text
+        # matching — keeps rolling upgrades safe
+        if self._c.chunk_rows > 0 and "framed_scan" in self._c.capabilities():
             chunked = dict(wire, chunk_rows=self._c.chunk_rows)
             try:
                 parts = [
@@ -804,13 +849,18 @@ class NetworkPEvents(base.PEvents):
                 ]
                 return _concat_batches(parts)
             except NetworkStorageError as e:
-                # a pre-framing server passes chunk_rows into its backing
-                # DAO and 400s; retry once on the legacy single-body wire
-                # so rolling upgrades don't break bulk reads
-                if "chunk_rows" not in str(e):
+                # one URL can front a mixed fleet mid-rolling-upgrade: the
+                # probe may have hit an upgraded replica while this request
+                # reached a legacy one, which 400s on the unknown chunk_rows
+                # arg. Retry on the legacy wire for exactly that status —
+                # transport faults and 5xx (server down, truncated stream)
+                # propagate immediately rather than silently re-running a
+                # multi-GB scan on the single-body wire
+                if e.status != 400:
                     raise
-                logger.info(
-                    "server rejected chunk_rows (%s); using single-body wire", e
+                logger.warning(
+                    "framed bulk scan rejected with 400 (%s); retrying once "
+                    "on the single-body wire (mixed-fleet tolerance)", e
                 )
         return batch_from_npz(self._c.call_binary("/pevents/find", wire))
 

@@ -364,6 +364,7 @@ def train_als(
     if cfg.checkpoint_dir:
         from predictionio_tpu.core.checkpoint import (
             CheckpointManager,
+            dataset_digest,
             save_due,
             validate_interval,
         )
@@ -380,9 +381,9 @@ def train_als(
                 cfg.rank,
                 int(cfg.implicit),
                 cfg.seed,
-                float(np.sum(rating, dtype=np.float64)),
-                float(np.sum(user, dtype=np.float64)),
-                float(np.sum(item, dtype=np.float64)),
+                # order-sensitive: a permuted dataset with equal element
+                # sums must NOT resume from a foreign checkpoint
+                dataset_digest(user, item, rating),
                 float(cfg.reg),
                 float(cfg.alpha),
                 # rebalance + shard count determine the on-disk row order

@@ -22,7 +22,6 @@ exchange collectives.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 from functools import partial
 from typing import Optional
 
@@ -450,6 +449,7 @@ def train_sasrec(
     if cfg.checkpoint_dir:
         from predictionio_tpu.core.checkpoint import (
             CheckpointManager,
+            dataset_digest,
             resume_from,
             save_due,
             validate_interval,
@@ -462,16 +462,9 @@ def train_sasrec(
                 n_items, n, batch, cfg.d_model, cfg.n_layers, cfg.n_heads,
                 cfg.max_len, float(cfg.lr), cfg.seed, cfg.n_experts,
                 float(cfg.expert_capacity), float(cfg.moe_aux_weight),
-                # order-sensitive dataset digest: a reordered/swapped history
-                # set must NOT resume from a foreign checkpoint (plain
-                # element sums are permutation-blind); 48 hex bits so the
-                # value is exact in this float64 array
-                int(
-                    hashlib.sha1(
-                        np.ascontiguousarray(seqs).tobytes()
-                    ).hexdigest()[:12],
-                    16,
-                ),
+                # order-sensitive: a reordered/swapped history set must
+                # NOT resume from a foreign checkpoint
+                dataset_digest(seqs),
                 int(cfg.seq_parallel),
             ],
             dtype=np.float64,

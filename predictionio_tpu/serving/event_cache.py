@@ -121,7 +121,9 @@ class ServingEventCache:
             self.stats.evictions += 1
 
     def _schedule_refresh(self, key: Hashable, loader: Callable[[], Any]) -> None:
-        started = time.monotonic()
+        # same clock as entry ages: with an injected test clock the staleness
+        # and hung-refresh timeout domains must not diverge
+        started = self._clock()
         with self._lock:
             inflight_since = self._inflight.get(key)
             if (
@@ -156,7 +158,15 @@ class ServingEventCache:
                     if self._inflight.get(key) == started:
                         del self._inflight[key]
 
-        executor.submit(work)
+        try:
+            executor.submit(work)
+        except RuntimeError:
+            # a concurrent close() shut the executor down between the lock
+            # release and submit; serving is winding down — drop the refresh
+            # (the stale value was already returned) and clear bookkeeping
+            with self._lock:
+                if self._inflight.get(key) == started:
+                    del self._inflight[key]
 
     def wait_refreshes(self, timeout: float = 5.0) -> None:
         """Block until no refresh is in flight (tests / graceful shutdown)."""

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 from typing import Optional
 
 import numpy as np
@@ -173,16 +174,26 @@ class ECommAlgorithm(Algorithm):
     # -- live lookups (parity: predict-time LEventStore reads :332-360),
     # served through the in-process TTL cache so steady-state queries make
     # zero storage round-trips (SURVEY.md §7) ------------------------------
+    # guards lazy cache creation: predict runs on multiple server threads,
+    # and an unguarded check-then-set would orphan one thread's cache (its
+    # in-flight dedup and stats silently lost)
+    _cache_init_lock = threading.Lock()
+
     @property
     def _cache(self):
         cache = getattr(self, "_event_cache", None)
         if cache is None:
-            from predictionio_tpu.serving.event_cache import ServingEventCache
+            with self._cache_init_lock:
+                cache = getattr(self, "_event_cache", None)
+                if cache is None:
+                    from predictionio_tpu.serving.event_cache import (
+                        ServingEventCache,
+                    )
 
-            cache = ServingEventCache(
-                refresh_interval=self.params.cacheRefreshSeconds
-            )
-            self._event_cache = cache
+                    cache = ServingEventCache(
+                        refresh_interval=self.params.cacheRefreshSeconds
+                    )
+                    self._event_cache = cache
         return cache
 
     def _seen_items(self, user: str) -> set:

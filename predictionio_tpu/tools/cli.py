@@ -630,7 +630,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="run a pio command as N coordinated processes (multi-host "
         "SPMD launch contract; Runner.runOnSpark role)",
     )
-    sp.add_argument("--num-processes", type=int, default=2)
+    sp.add_argument("-n", "--num-processes", type=int, default=2)
     sp.add_argument("--coordinator-port", type=int, default=7654)
     sp.add_argument(
         "--hosts",

@@ -19,7 +19,8 @@ SPMD contract (``parallel/distributed.py``):
   ssh, and the reference similarly delegates placement (to Spark).
 
 Every line of a worker's output is prefixed ``[p<i>] `` so interleaved
-logs stay attributable; exit status is the worst worker's.
+logs stay attributable; exit status is 0 only if every worker exited 0
+(signal-killed workers report negative codes and still fail the launch).
 """
 
 from __future__ import annotations
@@ -67,7 +68,11 @@ def launch_local(
 ) -> int:
     """Run ``pio <pio_args>`` as N coordinated local processes.
 
-    Returns the maximum worker exit code (0 iff all succeeded). A worker
+    Returns 0 iff every worker exited 0. Signal-killed workers report
+    negative codes on POSIX (SIGKILL=-9, SIGSEGV=-11), so ``max()`` alone
+    would mask a dead worker whenever any sibling exited 0; instead any
+    nonzero code — positive or negative — fails the launch, and the
+    failing process indices are logged with their raw codes. A worker
     that dies takes the rendezvous with it, so the rest exit too rather
     than hanging forever — jax.distributed's barrier sees the drop.
     """
@@ -91,7 +96,25 @@ def launch_local(
     rcs = [p.wait() for p in procs]
     for t in pumps:
         t.join(timeout=5)
-    return max(rcs)
+    return aggregate_exit_codes(rcs, out)
+
+
+def aggregate_exit_codes(rcs: Sequence[int], out=None) -> int:
+    """Collapse per-worker exit codes into the launch exit code.
+
+    0 only when EVERY worker exited 0 — ``max()`` would hide signal-killed
+    workers (negative POSIX codes: SIGKILL=-9, SIGSEGV=-11) behind any
+    sibling's 0. Negative codes map to 1 (shells can't carry them).
+    """
+    out = out or sys.stdout
+    failed = [(i, rc) for i, rc in enumerate(rcs) if rc != 0]
+    if not failed:
+        return 0
+    for i, rc in failed:
+        out.write(f"ERROR: process {i} exited with code {rc}\n")
+    out.flush()
+    first = failed[0][1]
+    return first if first > 0 else 1
 
 
 def render_host_commands(

@@ -202,3 +202,20 @@ print("OK one completed instance", completed[0].id)
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "OK one completed instance" in r.stdout
+
+
+def test_aggregate_exit_codes_signal_killed_worker_fails_launch():
+    """ADVICE r3 (medium): a signal-killed worker (negative POSIX code) must
+    fail the launch even when siblings exited 0 — max() would return 0."""
+    import io
+
+    from predictionio_tpu.tools.launcher import aggregate_exit_codes
+
+    out = io.StringIO()
+    assert aggregate_exit_codes([0, 0, 0], out) == 0
+    # SIGKILLed worker among successes: max([0, -9]) == 0 was the bug
+    assert aggregate_exit_codes([0, -9], out) == 1
+    assert "process 1 exited with code -9" in out.getvalue()
+    # positive codes propagate as-is; first failure wins
+    assert aggregate_exit_codes([0, 3, -11], io.StringIO()) == 3
+    assert aggregate_exit_codes([-11, 0], io.StringIO()) == 1

@@ -257,6 +257,66 @@ class TestChunkedBulkPull:
         batch = pe.find(app_id, event_names=["nonexistent"])
         assert len(batch) == 0
 
+    def test_capability_probe_advertises_framed_scan(self, served):
+        pe = served["client"].get_p_events()
+        assert "framed_scan" in pe._c.capabilities()
+        # cached: a second call must not re-probe (poison the URL to prove it)
+        old_url = pe._c.url
+        pe._c.url = "http://127.0.0.1:1"
+        try:
+            assert "framed_scan" in pe._c.capabilities()
+        finally:
+            pe._c.url = old_url
+
+    def test_legacy_server_stays_on_single_body_wire(self, served, monkeypatch):
+        # a pre-capability server advertises nothing on GET /; the client's
+        # REAL probe must resolve empty, stay on the legacy wire (no
+        # error-text sniffing, no 400s), and not cache the downgrade —
+        # once the server upgrades, the next probe picks up framing
+        from predictionio_tpu.data.storage import network as net
+
+        app_id = self._seed(served["backing"], n=100)
+        pe = served["client"].get_p_events()
+        pe._c.chunk_rows = 16
+        monkeypatch.setattr(net, "SERVER_CAPABILITIES", frozenset())
+        assert pe._c.capabilities() == frozenset()
+        batch = pe.find(app_id)
+        assert len(batch) == 100
+        # mixed fleet finishes upgrading: the very next probe sees framing
+        # (an empty probe result must not have been cached)
+        monkeypatch.setattr(net, "SERVER_CAPABILITIES", frozenset({"framed_scan"}))
+        assert "framed_scan" in pe._c.capabilities()
+
+    def test_mixed_fleet_400_falls_back_single_body(self, served, monkeypatch):
+        # probe says framed (upgraded replica) but the data request lands on
+        # a legacy replica that 400s on chunk_rows: one structural retry on
+        # the legacy wire, gated on the status code — a 5xx propagates
+        from predictionio_tpu.data.storage import network as net
+
+        app_id = self._seed(served["backing"], n=50)
+        pe = served["client"].get_p_events()
+        pe._c.chunk_rows = 16
+        assert "framed_scan" in pe._c.capabilities()  # cache the upgraded view
+        real_iter = pe._c.iter_frames
+
+        def legacy_replica(path, args):
+            if "chunk_rows" in args:
+                raise net.NetworkStorageError(
+                    f"{path}: unexpected argument chunk_rows", status=400
+                )
+            return real_iter(path, args)
+
+        monkeypatch.setattr(pe._c, "iter_frames", legacy_replica)
+        batch = pe.find(app_id)  # retried on the single-body wire
+        assert len(batch) == 50
+
+        def dead_replica(path, args):
+            raise net.NetworkStorageError(f"{path}: boom", status=500)
+
+        monkeypatch.setattr(pe._c, "iter_frames", dead_replica)
+        with pytest.raises(net.NetworkStorageError):
+            pe.find(app_id)
+
     def test_unframed_response_fallback(self, served):
         # an endpoint that answers with a plain body: iter_frames must
         # yield it once instead of misparsing it as frames
