@@ -97,7 +97,7 @@ def _make_interactions(dist: str, n_users: int, n_items: int, n_ratings: int):
     return inter
 
 
-def _timed_run(ctx, inter, rank, iterations, dtype, n_chips) -> float:
+def _timed_run(ctx, inter, rank, iterations, dtype, n_chips):
     from predictionio_tpu.models import als
 
     # warm-up: compile the step (first TPU compile is slow, cached after)
@@ -105,13 +105,159 @@ def _timed_run(ctx, inter, rank, iterations, dtype, n_chips) -> float:
         ctx, inter, als.ALSConfig(rank=rank, iterations=1, compute_dtype=dtype)
     )
     t0 = time.perf_counter()
-    als.train_als(
+    model = als.train_als(
         ctx,
         inter,
         als.ALSConfig(rank=rank, iterations=iterations, compute_dtype=dtype),
     )
     dt = time.perf_counter() - t0
-    return len(inter.rating) * iterations / dt / n_chips
+    return len(inter.rating) * iterations / dt / n_chips, model, dt
+
+
+# Per-chip peaks for utilization accounting. v5e: 197 TFLOP/s bf16 MXU,
+# 819 GB/s HBM (public spec). mfu is defined against the bf16 peak — the
+# number the hardware markets — so a 10× utilization regression is visible
+# regardless of the dtype in use. Platforms not listed report null.
+_PEAKS = {"tpu": {"flops": 197e12, "hbm_gbps": 819e9}}
+
+
+def _utilization(
+    n_ratings, n_users, n_items, rank, iterations, dtype, dt, n_chips, platform
+):
+    """Analytic achieved-FLOP/s + HBM-GB/s from workload dims and wall time.
+
+    Cost model (both half-steps of one iteration, dense solver):
+      FLOPs: per rating 2·(2k² + 4k) madds (outer product + rhs accumulate,
+      both sides) + per entity 2·(k³/3) Cholesky factor+solve madds.
+      HBM bytes: per rating, both sides: k·s gather read + 12 B of
+      idx/rat/msk + k·s of A-tile write amortized; per entity k·4 factor
+      write + opposite-factor read once per half-step.
+    A model, not a measurement — good for regression visibility, not for
+    publishing as achieved hardware counters.
+    """
+    k = rank
+    s = 2 if dtype == "bf16" else 4  # bytes per factor element
+    ents = n_users + n_items
+    flops_per_iter = n_ratings * 2 * (2 * k * k + 4 * k) * 2 + ents * (
+        2 * k**3 / 3
+    )
+    bytes_per_iter = (
+        n_ratings * 2 * (k * s + 12)  # gather + idx/rat/msk streams
+        + ents * k * (4 + s)  # factor write (f32) + opposite read
+    )
+    flops = flops_per_iter * iterations / dt / n_chips
+    gbps = bytes_per_iter * iterations / dt / n_chips
+    peak = _PEAKS.get(platform)
+    return {
+        "model_flops_per_sec_per_chip": round(flops / 1e9, 2),  # GFLOP/s
+        "model_hbm_gbps_per_chip": round(gbps / 1e9, 2),
+        "mfu": round(flops / peak["flops"], 6) if peak else None,
+        "hbm_util": round(gbps / peak["hbm_gbps"], 6) if peak else None,
+    }
+
+
+def _scorer_latency(ctx, model, on_device, n_queries=300, warmup=20) -> dict:
+    """p50/p99 of direct ALSScorer.recommend (the in-process serving path)."""
+    from predictionio_tpu.models.als import ALSScorer
+
+    scorer = ALSScorer(ctx, model, on_device=on_device)
+    rng = np.random.default_rng(7)
+    users = rng.integers(0, model.user_factors.shape[0], n_queries + warmup)
+    lat = []
+    for i, u in enumerate(users):
+        t0 = time.perf_counter()
+        scorer.recommend(int(u), 10)
+        if i >= warmup:
+            lat.append(time.perf_counter() - t0)
+    lat.sort()
+    q = lambda p: round(lat[min(int(p * len(lat)), len(lat) - 1)] * 1e3, 3)
+    return {
+        "p50": q(0.50), "p99": q(0.99), "queries": n_queries,
+        "on_device": scorer.on_device,
+    }
+
+
+def _http_latency(ctx, dist, n_users, n_items) -> dict:
+    """p50/p99 of the FULL REST predict path: synthetic events → real
+    template train → QueryServer → loadtest POST /queries.json.
+
+    Parity: the reference's per-request serving timer
+    (core/.../workflow/CreateServer.scala:597-604). The model's factor
+    SHAPES match the training bench (scoring cost is O(n_items·k) per
+    query, independent of how many ratings trained it), so a small
+    training pass serves an honestly-sized catalog.
+    """
+    import uuid
+
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.data.batch import EventBatch
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.serving.query_server import QueryServer
+    from predictionio_tpu.templates.recommendation import RecommendationEngine
+    from predictionio_tpu.tools.loadtest import run_loadtest
+
+    n_events = int(os.environ.get("BENCH_SERVING_EVENTS", 1_000_000))
+    src = "BENCH" + uuid.uuid4().hex[:6].upper()
+    storage = Storage(env={
+        f"PIO_STORAGE_SOURCES_{src}_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+    })
+    store_mod.set_storage(storage)
+    try:
+        app_id = storage.get_meta_data_apps().insert(App(0, "benchapp"))
+        storage.get_l_events().init(app_id)
+        rng = np.random.default_rng(11)
+        users = _sample_ids(rng, n_users, n_events, dist, s=0.7)
+        items = _sample_ids(rng, n_items, n_events, dist, s=1.1)
+        now = time.time()
+        batch = EventBatch(
+            event=np.full(n_events, "rate", object),
+            entity_type=np.full(n_events, "user", object),
+            entity_id=np.array([f"u{u}" for u in users], object),
+            target_entity_type=np.full(n_events, "item", object),
+            target_entity_id=np.array([f"i{i}" for i in items], object),
+            event_time=np.full(n_events, now, np.float64),
+            properties=[
+                {"rating": float(r)}
+                for r in rng.integers(1, 6, n_events)
+            ],
+        )
+        storage.get_p_events().write(batch, app_id)
+        engine = RecommendationEngine.apply()
+        ep = engine.params_from_variant({
+            "datasource": {"params": {"appName": "benchapp"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 10, "numIterations": 2}}
+            ],
+        })
+        run_train(engine, ep, "bench", storage=storage, ctx=ctx)
+        qs = QueryServer(engine, storage=storage, ctx=ctx)
+        port = qs.start("127.0.0.1", 0)
+        try:
+            url = f"http://127.0.0.1:{port}"
+            run_loadtest(url, {"user": f"u{users[0]}", "num": 10}, requests=40,
+                         concurrency=2)  # warm the path + jit
+            res = run_loadtest(
+                url, {"user": f"u{users[0]}", "num": 10},
+                requests=int(os.environ.get("BENCH_HTTP_REQUESTS", 300)),
+                concurrency=4,
+            )
+        finally:
+            qs.stop()
+        return {
+            "p50": res["p50Ms"], "p99": res["p99Ms"], "qps": res["qps"],
+            "requests": res["requests"], "errors": res["errors"],
+            "serving_events": n_events,
+        }
+    finally:
+        store_mod.set_storage(None)
+        from predictionio_tpu.data.storage import memory
+
+        memory.reset_store(src)
 
 
 def main() -> None:
@@ -159,9 +305,13 @@ def main() -> None:
     platform = jax.devices()[0].platform
 
     results: dict[str, float] = {}
+    models: dict[str, object] = {}
+    times: dict[str, float] = {}
     for d in ("uniform", "zipf") if dist == "both" else (dist,):
         inter = _make_interactions(d, n_users, n_items, n_ratings)
-        results[d] = _timed_run(ctx, inter, rank, iterations, dtype, n_chips)
+        results[d], models[d], times[d] = _timed_run(
+            ctx, inter, rank, iterations, dtype, n_chips
+        )
         print(
             f"INFO: {d} distribution: {results[d]:.1f} events/s/chip",
             file=sys.stderr,
@@ -170,6 +320,32 @@ def main() -> None:
     primary_dist = "uniform" if "uniform" in results else dist
     value = results[primary_dist]
     on_tpu = platform == "tpu" and not fallback
+
+    utilization = _utilization(
+        n_ratings, n_users, n_items, rank, iterations, dtype,
+        times[primary_dist], n_chips, platform,
+    )
+    print(f"INFO: utilization: {utilization}", file=sys.stderr)
+
+    latency = None
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        # serving benches must never kill the artifact: the training number
+        # above is already earned, so failures degrade to an error field
+        try:
+            scorer_lat = _scorer_latency(
+                ctx, models[primary_dist], on_device=True if on_tpu else None
+            )
+        except Exception as e:
+            print(f"WARNING: scorer latency bench failed: {e}", file=sys.stderr)
+            scorer_lat = {"error": str(e)}
+        print(f"INFO: scorer latency: {scorer_lat}", file=sys.stderr)
+        try:
+            http_lat = _http_latency(ctx, primary_dist, n_users, n_items)
+        except Exception as e:
+            print(f"WARNING: http latency bench failed: {e}", file=sys.stderr)
+            http_lat = {"error": str(e)}
+        print(f"INFO: http latency: {http_lat}", file=sys.stderr)
+        latency = {"scorer": scorer_lat, "http": http_lat}
     record = {
         "metric": "als_train_events_per_sec_per_chip",
         "value": round(value, 1),
@@ -190,6 +366,9 @@ def main() -> None:
             "distribution": primary_dist,
         },
     }
+    record["utilization"] = utilization
+    if latency is not None:
+        record["predict_latency_ms"] = latency
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

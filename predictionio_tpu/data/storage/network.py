@@ -661,19 +661,22 @@ class _Client:
         A pre-capability server returns no ``capabilities`` field — the
         caller falls back to the legacy wire structurally, never by matching
         error text (rolling-upgrade contract). Only non-empty capability
-        sets are cached: a failed probe (server restarting) or a legacy
-        answer (mixed fleet mid-upgrade) yields "none" for THIS call but
-        re-probes on the next, so a long-lived client is never permanently
-        downgraded to the single-body wire. Bulk scans are heavy and rare;
-        one extra GET per scan against a legacy server is noise.
+        sets are cached: a legacy answer (mixed fleet mid-upgrade) reads
+        "none" for THIS call but re-probes on the next, so a long-lived
+        client is never permanently downgraded to the single-body wire.
+        A probe TRANSPORT failure raises instead — the server is down or
+        mid-restart, and silently downgrading would run the very
+        whole-body scan the framed wire exists to avoid. Bulk scans are
+        heavy and rare; one extra GET per scan against a legacy server is
+        noise.
         """
         if self._caps is None:
+            payload, _ = self._request("GET", "/", None, "application/json")
             try:
-                payload, _ = self._request("GET", "/", None, "application/json")
                 info = json.loads(payload.decode())
                 caps = frozenset(info.get("capabilities") or ())
             except Exception:
-                return frozenset()
+                caps = frozenset()  # unparseable index = legacy server
             if not caps:
                 return caps
             self._caps = caps

@@ -73,11 +73,26 @@ class ALSConfig:
     # every shard to the hottest block's length. Pure host-side; factors
     # are returned in original id order either way.
     rebalance: bool = True
+    # Normal-equation accumulation strategy:
+    #   "dense"   — degree-bucketed batched einsum (the TPU path): entities
+    #               are relabeled so each shard holds them in descending
+    #               rating-count order, split into power-of-two degree
+    #               buckets, and each bucket's Σ v vᵀ / Σ r v reduces as one
+    #               gather + batched matmul — MXU work, ZERO scatter.
+    #   "segment" — rating-stream segment_sum (scatter-add) accumulation;
+    #               the strict fallback (the native.py discipline) and the
+    #               reference-shaped formulation.
+    # PIO_ALS_SOLVER overrides the default for benchmarking A/B.
+    solver: str = os.environ.get("PIO_ALS_SOLVER", "dense")
 
     def __post_init__(self):
         if self.compute_dtype not in ("f32", "bf16"):
             raise ValueError(
                 f"compute_dtype must be 'f32' or 'bf16', got {self.compute_dtype!r}"
+            )
+        if self.solver not in ("dense", "segment"):
+            raise ValueError(
+                f"solver must be 'dense' or 'segment', got {self.solver!r}"
             )
 
 
@@ -192,6 +207,133 @@ def _make_blocks(
 
 
 # ---------------------------------------------------------------------------
+# Dense (degree-bucketed) blocking: the scatter-free TPU formulation
+# ---------------------------------------------------------------------------
+
+
+# Upper bound on elements per bucket gather intermediate (n_b·D_b); bounds
+# the (n_b, D_b, k) gathered-factor tensor to ~chunk·k·4 bytes of HBM peak.
+_DENSE_CHUNK = int(os.environ.get("PIO_ALS_DENSE_CHUNK", 4_194_304))
+
+
+@dataclasses.dataclass
+class _DenseBlocks:
+    """Per-bucket dense rating matrices, ready for shard_map over 'data'.
+
+    Bucket b covers the contiguous local-entity range [starts[b], ends[b])
+    (IDENTICAL across shards — shard_map runs one program) with row width
+    widths[b] ≥ every member entity's rating count.  For each bucket:
+    ``idx``/``rat``/``msk`` are (n_shards, n_entities_b, width_b); padding
+    slots carry idx 0 and msk 0, contributing exactly zero.
+    """
+
+    idx: list  # of (n_shards, n_b, D_b) int32 — global opposite-entity ids
+    rat: list  # of (n_shards, n_b, D_b) float32
+    msk: list  # of (n_shards, n_b, D_b) float32
+    widths: list  # of int
+    per_shard: int
+    padded_ratings: int  # Σ shards·n_b·D_b — the real device workload size
+
+
+def _degree_sort_permutation(
+    entity: np.ndarray, n_entity_pad: int, n_shards: int
+) -> np.ndarray:
+    """Within each shard's id range, relabel entities by descending rating
+    count (shard membership unchanged). The dense solver needs monotone
+    per-shard degrees so contiguous local ranges form degree buckets; when
+    LPT rebalancing is on its permutation already guarantees this, this is
+    the rebalance=False companion."""
+    counts = np.bincount(entity, minlength=n_entity_pad)
+    per_shard = n_entity_pad // n_shards
+    perm = np.empty(n_entity_pad, np.int64)
+    for p in range(n_shards):
+        lo = p * per_shard
+        order = np.argsort(-counts[lo : lo + per_shard], kind="stable")
+        perm[lo + order] = lo + np.arange(per_shard)
+    return perm
+
+
+def _bucket_boundaries(dmax: np.ndarray, chunk_budget: int) -> list:
+    """Split a non-increasing per-local-id max-degree curve into
+    (start, end, width) buckets: width = next multiple of 8 ≥ the bucket's
+    top degree, members keep degree ≥ width/2 (≤2× padding waste), and
+    n·width ≤ chunk_budget bounds each gather intermediate."""
+    per_shard = len(dmax)
+    out = []
+    j = 0
+    while j < per_shard:
+        width = max(8, int(-8 * (-int(dmax[j]) // 8)))  # pad8, floor 8
+        cap = max(1, chunk_budget // width)
+        j1 = j + 1
+        while (
+            j1 < per_shard
+            and (j1 - j) < cap
+            and (width == 8 or int(dmax[j1]) >= width // 2)
+        ):
+            j1 += 1
+        out.append((j, j1, width))
+        j = j1
+    return out
+
+
+def _make_dense_blocks(
+    entity: np.ndarray,
+    other: np.ndarray,
+    rating: np.ndarray,
+    n_entity_pad: int,
+    n_shards: int,
+    chunk_budget: int = None,
+) -> _DenseBlocks:
+    """Build degree-bucketed dense rating matrices (host side).
+
+    Requires per-shard-monotone degrees (apply the LPT or degree-sort
+    permutation first).  All ratings of one entity land in one row of one
+    bucket; the device half-step then needs no scatter at all.
+    """
+    chunk_budget = chunk_budget or _DENSE_CHUNK
+    per_shard = n_entity_pad // n_shards
+    deg = np.bincount(entity, minlength=n_entity_pad).reshape(
+        n_shards, per_shard
+    )
+    bounds = _bucket_boundaries(deg.max(axis=0), chunk_budget)
+
+    # sort triples by (shard, local id): each (shard, bucket) is then one
+    # contiguous slice, and column position = rank within the entity
+    shard = entity // per_shard
+    order = np.lexsort((entity, shard))
+    entity_s, other_s, rating_s = entity[order], other[order], rating[order]
+    offsets = np.concatenate(
+        [[0], np.cumsum(deg.reshape(-1))]
+    )  # by global blocked id
+    pos = np.arange(len(entity_s)) - offsets[entity_s]
+
+    idx_l, rat_l, msk_l, widths = [], [], [], []
+    padded = 0
+    for j0, j1, width in bounds:
+        n_b = j1 - j0
+        idx_b = np.zeros((n_shards, n_b, width), np.int32)
+        rat_b = np.zeros((n_shards, n_b, width), np.float32)
+        msk_b = np.zeros((n_shards, n_b, width), np.float32)
+        for p in range(n_shards):
+            s = offsets[p * per_shard + j0]
+            e = offsets[p * per_shard + j1]
+            rows = entity_s[s:e] - (p * per_shard + j0)
+            cols = pos[s:e]
+            idx_b[p, rows, cols] = other_s[s:e]
+            rat_b[p, rows, cols] = rating_s[s:e]
+            msk_b[p, rows, cols] = 1.0
+        idx_l.append(idx_b)
+        rat_l.append(rat_b)
+        msk_l.append(msk_b)
+        widths.append(width)
+        padded += n_shards * n_b * width
+    return _DenseBlocks(
+        idx=idx_l, rat=rat_l, msk=msk_l, widths=widths,
+        per_shard=per_shard, padded_ratings=padded,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Device-side half-step: solve one side's factors from the other's
 # ---------------------------------------------------------------------------
 
@@ -218,7 +360,6 @@ def _half_step_local(
     L = local.shape[0]
     chunk = min(L, _CHUNK)
     n_chunks = L // chunk
-    eye = jnp.eye(rank, dtype=jnp.float32)
     if bf16:
         opp_full = opp_full.astype(jnp.bfloat16)
 
@@ -254,6 +395,12 @@ def _half_step_local(
         for a in (local, other, rating, mask)
     )
     (A, b, cnt), _ = jax.lax.scan(body, init, xs)
+    return _solve_normal_equations(A, b, cnt, gram, rank, reg, implicit)
+
+
+def _solve_normal_equations(A, b, cnt, gram, rank, reg, implicit):
+    """Ridge + batched k×k Cholesky, shared by both accumulation paths."""
+    eye = jnp.eye(rank, dtype=jnp.float32)
     if implicit:
         A = A + gram[None, :, :] + reg * eye[None, :, :]
     else:
@@ -262,6 +409,96 @@ def _half_step_local(
     chol = jax.scipy.linalg.cho_factor(A)
     x = jax.scipy.linalg.cho_solve(chol, b[:, :, None])[:, :, 0]
     return x.astype(jnp.float32)
+
+
+def _dense_half_step_local(
+    *args, n_buckets, rank, reg, implicit, alpha, bf16=False
+):
+    """Scatter-free half-step: per degree bucket, one gather + batched
+    einsum accumulates the normal equations — contraction rides the MXU,
+    padding slots multiply by zero, and because bucket rows ARE the local
+    entity order the per-bucket results simply concatenate (no scatter).
+    With bf16, factors gather and multiply in bfloat16 while the einsum
+    accumulates f32 (``preferred_element_type``), the MXU-native mode.
+    """
+    bufs = args[: 3 * n_buckets]
+    opp_full, gram = args[3 * n_buckets], args[3 * n_buckets + 1]
+    opp = opp_full.astype(jnp.bfloat16) if bf16 else opp_full
+    f32 = jnp.float32
+    As, bs, cnts = [], [], []
+    for i in range(n_buckets):
+        # shard_map blocks keep the leading mesh dim: (1, n_b, D_b) → [0]
+        idx = bufs[3 * i][0]
+        rat = bufs[3 * i + 1][0]
+        msk = bufs[3 * i + 2][0]
+        Vg = opp[idx]  # (n_b, D_b, k) gather in compute dtype
+        w = msk.astype(Vg.dtype)
+        if implicit:
+            # A_u += Σ α·r · v vᵀ ;  b_u += Σ (1+α·r) · v   (p=1, c=1+αr)
+            cw = (alpha * rat).astype(Vg.dtype) * w
+            A = jnp.einsum(
+                "edk,edl->ekl", Vg * cw[:, :, None], Vg,
+                preferred_element_type=f32,
+            )
+            bv = jnp.einsum(
+                "edk,ed->ek", Vg, (1.0 + alpha * rat).astype(Vg.dtype) * w,
+                preferred_element_type=f32,
+            )
+            cnt = jnp.zeros(idx.shape[0], f32)
+        else:
+            W = Vg * w[:, :, None]
+            A = jnp.einsum("edk,edl->ekl", W, W, preferred_element_type=f32)
+            bv = jnp.einsum(
+                "edk,ed->ek", W, rat.astype(Vg.dtype),
+                preferred_element_type=f32,
+            )
+            cnt = msk.sum(-1)
+        As.append(A)
+        bs.append(bv)
+        cnts.append(cnt)
+    A = jnp.concatenate(As)
+    b = jnp.concatenate(bs)
+    cnt = jnp.concatenate(cnts)
+    return _solve_normal_equations(A, b, cnt, gram, rank, reg, implicit)
+
+
+def _make_dense_step(mesh, ub: _DenseBlocks, ib: _DenseBlocks, cfg: ALSConfig):
+    """Build the jitted full ALS iteration over the mesh (dense solver)."""
+    rank, reg, alpha, implicit = cfg.rank, cfg.reg, cfg.alpha, cfg.implicit
+
+    def one_side(blocks: _DenseBlocks):
+        nb = len(blocks.widths)
+        kernel = partial(
+            _dense_half_step_local,
+            n_buckets=nb,
+            rank=rank,
+            reg=reg,
+            implicit=implicit,
+            alpha=alpha,
+            bf16=(cfg.compute_dtype == "bf16"),
+        )
+        specs = tuple(P(DATA_AXIS) for _ in range(3 * nb)) + (P(), P())
+        return shard_map(
+            kernel, mesh=mesh, in_specs=specs, out_specs=P(DATA_AXIS, None)
+        )
+
+    u_solve = one_side(ub)
+    v_solve = one_side(ib)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(U, V, u_bufs, i_bufs):
+        zero_gram = jnp.zeros((rank, rank), jnp.float32)
+        if implicit:
+            gram_v = V.T @ V  # (k,k); XLA reduces across shards (psum on ICI)
+            U = u_solve(*u_bufs, V, gram_v)
+            gram_u = U.T @ U
+            V = v_solve(*i_bufs, U, gram_u)
+        else:
+            U = u_solve(*u_bufs, V, zero_gram)
+            V = v_solve(*i_bufs, U, zero_gram)
+        return U, V
+
+    return step
 
 
 def _make_step(mesh, ub: _Blocks, ib: _Blocks, cfg: ALSConfig):
@@ -326,38 +563,65 @@ def train_als(
     item = interactions.item.astype(np.int64)
     rating = interactions.rating.astype(np.float32)
 
+    dense = cfg.solver == "dense"
     u_perm = i_perm = None
     if cfg.rebalance and n_shards > 1:
         u_perm = _balance_permutation(user, n_users_pad, n_shards)
         i_perm = _balance_permutation(item, n_items_pad, n_shards)
-        user_blk = u_perm[user]
-        item_blk = i_perm[item]
-    else:
-        user_blk, item_blk = user, item
+    elif dense:
+        # dense bucketing needs per-shard-monotone degrees; LPT already
+        # guarantees that, this is the rebalance-off companion
+        u_perm = _degree_sort_permutation(user, n_users_pad, n_shards)
+        i_perm = _degree_sort_permutation(item, n_items_pad, n_shards)
+    user_blk = u_perm[user] if u_perm is not None else user
+    item_blk = i_perm[item] if i_perm is not None else item
 
-    ub = _make_blocks(user_blk, item_blk, rating, n_users_pad, n_shards)
-    ib = _make_blocks(item_blk, user_blk, rating, n_items_pad, n_shards)
+    if dense:
+        ub = _make_dense_blocks(user_blk, item_blk, rating, n_users_pad, n_shards)
+        ib = _make_dense_blocks(item_blk, user_blk, rating, n_items_pad, n_shards)
+    else:
+        ub = _make_blocks(user_blk, item_blk, rating, n_users_pad, n_shards)
+        ib = _make_blocks(item_blk, user_blk, rating, n_items_pad, n_shards)
 
     key = jax.random.PRNGKey(cfg.seed)
     ku, kv = jax.random.split(key)
     scale = 1.0 / np.sqrt(cfg.rank)
     sharding = ctx.sharding(DATA_AXIS, None)
-    U = jax.device_put(
-        jax.random.normal(ku, (n_users_pad, cfg.rank), jnp.float32) * scale, sharding
-    )
-    V = jax.device_put(
-        jax.random.normal(kv, (n_items_pad, cfg.rank), jnp.float32) * scale, sharding
-    )
+
+    def init_factors(k, n_pad, perm):
+        # row e of the BASE draw belongs to ORIGINAL entity e; placing it at
+        # blocked position perm[e] makes the effective per-entity init (and
+        # thus the trained model) invariant to relabeling — solver/rebalance
+        # choices change layout, never the optimization trajectory's start
+        base = jax.random.normal(k, (n_pad, cfg.rank), jnp.float32) * scale
+        if perm is not None:
+            base = base[np.argsort(perm)]
+        return jax.device_put(base, sharding)
+
+    U = init_factors(ku, n_users_pad, u_perm)
+    V = init_factors(kv, n_items_pad, i_perm)
+
+    sh_rows = ctx.sharding(DATA_AXIS)
 
     def put(b: _Blocks):
-        sh = ctx.sharding(DATA_AXIS)
         return tuple(
-            jax.device_put(jnp.asarray(a), sh)
+            jax.device_put(jnp.asarray(a), sh_rows)
             for a in (b.local, b.other, b.rating, b.mask)
         )
 
-    u_blocks, i_blocks = put(ub), put(ib)
-    step = _make_step(ctx.mesh, ub, ib, cfg)
+    def put_dense(b: _DenseBlocks):
+        bufs = []
+        for i in range(len(b.widths)):
+            for a in (b.idx[i], b.rat[i], b.msk[i]):
+                bufs.append(jax.device_put(jnp.asarray(a), sh_rows))
+        return tuple(bufs)
+
+    if dense:
+        u_blocks, i_blocks = put_dense(ub), put_dense(ib)
+        step = _make_dense_step(ctx.mesh, ub, ib, cfg)
+    else:
+        u_blocks, i_blocks = put(ub), put(ib)
+        step = _make_step(ctx.mesh, ub, ib, cfg)
 
     start_iter = 0
     manager = None
@@ -386,10 +650,12 @@ def train_als(
                 dataset_digest(user, item, rating),
                 float(cfg.reg),
                 float(cfg.alpha),
-                # rebalance + shard count determine the on-disk row order
-                # of U/V (the permutation is a function of both): a
-                # checkpoint from any other layout must not resume
+                # rebalance + solver + shard count determine the on-disk
+                # row order of U/V (the permutation is a function of all
+                # three — the dense solver relabels even when rebalance is
+                # off): a checkpoint from any other layout must not resume
                 int(cfg.rebalance),
+                int(dense),
                 n_shards,
             ],
             dtype=np.float64,
