@@ -239,7 +239,83 @@ class PEvents(abc.ABC):
         event_names: Optional[Sequence[str]] = None,
         target_entity_type: Optional[str] = None,
         target_entity_id: Optional[str] = None,
-    ) -> EventBatch: ...
+        shard: Optional[tuple] = None,
+        shard_key: str = "row",
+    ) -> EventBatch:
+        """Filtered columnar scan, optionally SHARDED for multi-host ingest.
+
+        ``shard=(index, count)`` returns a disjoint 1/count-th of the
+        matching rows; the union over all indices is exactly the full
+        result (parity role: Spark JDBC partitioned reads,
+        ``JDBCPEvents.scala:35-119``). ``shard_key`` picks the partition
+        rule:
+
+        * ``"row"``    — positional (row i → shard i % count): even split,
+          no locality guarantee.
+        * ``"entity"`` — ``shard_hash(entity_id) % count``: ALL events of
+          one entity land on one shard (what blocked trainers need for the
+          user-side pass).
+        * ``"target"`` — same, keyed by ``target_entity_id`` (the
+          item-side pass); rows without a target go to shard 0.
+        """
+
+    @staticmethod
+    def shard_hash(s: str) -> int:
+        """The cross-driver entity→shard hash: crc32 of UTF-8 bytes.
+
+        Deterministic across processes and runs (unlike Python's salted
+        ``hash``) so every host computes the same assignment.
+        """
+        import zlib
+
+        return zlib.crc32(s.encode("utf-8"))
+
+    @classmethod
+    def shard_select(
+        cls, batch: EventBatch, shard: Optional[tuple], shard_key: str
+    ) -> EventBatch:
+        """Reference row-filter implementation drivers may apply post-scan
+        when they cannot push the predicate deeper."""
+        if shard is None:
+            return batch
+        index, count = int(shard[0]), int(shard[1])
+        if count <= 1:
+            return batch
+        import numpy as np
+
+        if shard_key == "row":
+            keep = (np.arange(len(batch)) % count) == index
+        elif shard_key in ("entity", "target"):
+            col = (
+                batch.entity_id if shard_key == "entity"
+                else batch.target_entity_id
+            )
+            keep = cls._entity_shard_of(col, count) == index
+        else:
+            raise ValueError(f"unknown shard_key {shard_key!r}")
+        return batch.select(keep)
+
+    @classmethod
+    def _entity_shard_of(cls, col, count: int):
+        """Vectorized per-row shard assignment: hash the UNIQUES
+        (|entities| crc32 calls, not |rows|) and broadcast through the
+        inverse indices; rows without a target (None) go to shard 0."""
+        import numpy as np
+
+        col = np.asarray(col, dtype=object)
+        is_none = np.fromiter(
+            (s is None for s in col), dtype=bool, count=len(col)
+        )
+        uniq, inv = np.unique(
+            np.where(is_none, "", col).astype(object), return_inverse=True
+        )
+        ushard = np.fromiter(
+            (cls.shard_hash(str(s)) % count for s in uniq),
+            dtype=np.int64, count=len(uniq),
+        )
+        out = ushard[inv]
+        out[is_none] = 0
+        return out
 
     def aggregate_properties(
         self,
@@ -269,11 +345,17 @@ class PEvents(abc.ABC):
         target_entity_type: Optional[str] = None,
         rating_key: Optional[str] = None,
         default_rating: float = 1.0,
+        shard: Optional[tuple] = None,
+        shard_key: str = "row",
     ):
         """Bulk (user, item, rating, t) triples for training reads.
 
         Default: ``find`` + ``EventBatch.interactions``. Columnar drivers
-        override with zero-row-materialization fast paths.
+        override with zero-row-materialization fast paths. ``shard``/
+        ``shard_key`` as in :meth:`find`: a sharded read returns triples
+        for 1/count-th of the rows, with id maps built from the LOCAL
+        shard only (multi-host callers merge maps globally —
+        ``parallel/ingest.py``).
         """
         return self.find(
             app_id,
@@ -281,6 +363,8 @@ class PEvents(abc.ABC):
             entity_type=entity_type,
             event_names=event_names,
             target_entity_type=target_entity_type,
+            shard=shard,
+            shard_key=shard_key,
         ).interactions(rating_key=rating_key, default_rating=default_rating)
 
     @abc.abstractmethod

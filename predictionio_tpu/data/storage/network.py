@@ -200,7 +200,10 @@ FRAMES_CONTENT_TYPE = "application/x-pio-frames"
 # Wire features this server build speaks, advertised on ``GET /``. Clients
 # consult the list before choosing a format — a pre-capability server simply
 # has no list, which reads as "legacy wire only" with no error-text sniffing.
-SERVER_CAPABILITIES = frozenset({"framed_scan"})
+# "sharded_scan": find/find_interactions accept shard=(index, count) +
+# shard_key pushdown (a pre-sharding server 400s LOUDLY on them — silently
+# returning full data to every worker would duplicate ratings N×).
+SERVER_CAPABILITIES = frozenset({"framed_scan", "sharded_scan"})
 
 
 def batch_from_npz(data: bytes) -> EventBatch:
@@ -835,6 +838,13 @@ class NetworkPEvents(base.PEvents):
         self._c = _Client(**kw)
 
     def find(self, app_id, channel_id=None, **kwargs):
+        if kwargs.get("shard") is None:
+            # never put shard args on the wire for unsharded reads: a
+            # pre-sharding server must keep serving new clients' plain scans
+            kwargs.pop("shard", None)
+            kwargs.pop("shard_key", None)
+        else:
+            kwargs["shard"] = [int(kwargs["shard"][0]), int(kwargs["shard"][1])]
         wire = _find_kwargs_to_wire(kwargs)
         wire["app_id"] = app_id
         if channel_id is not None:
@@ -869,7 +879,8 @@ class NetworkPEvents(base.PEvents):
 
     def find_interactions(self, app_id, channel_id=None, entity_type=None,
                           event_names=None, target_entity_type=None,
-                          rating_key=None, default_rating=1.0):
+                          rating_key=None, default_rating=1.0,
+                          shard=None, shard_key="row"):
         wire: dict[str, Any] = {"app_id": app_id, "default_rating": default_rating}
         if channel_id is not None:
             wire["channel_id"] = channel_id
@@ -881,6 +892,11 @@ class NetworkPEvents(base.PEvents):
             wire["target_entity_type"] = target_entity_type
         if rating_key is not None:
             wire["rating_key"] = rating_key
+        if shard is not None:
+            # pushed to the server so only 1/count-th crosses the wire —
+            # the N× ingest fix for multi-host training reads
+            wire["shard"] = [int(shard[0]), int(shard[1])]
+            wire["shard_key"] = shard_key
         return interactions_from_npz(
             self._c.call_binary("/pevents/interactions", wire)
         )

@@ -253,6 +253,54 @@ def _degree_sort_permutation(
     return perm
 
 
+def _sharded_balance_permutation(
+    counts: np.ndarray,
+    owner: np.ndarray,
+    n_hosts: int,
+    d_local: int,
+    per_shard: int,
+) -> np.ndarray:
+    """Global old-id → blocked-id relabeling for sharded multi-host ingest.
+
+    Entity e's rows live only on host ``owner[e]`` (the DAO shard hash), so
+    its factor row must land in one of that host's ``d_local`` device
+    shards. Within each host: LPT over its shards (descending global count
+    → lightest shard with a free slot), giving per-shard-monotone degrees —
+    the dense-bucketing precondition. Slots left over (padding ids) fill
+    deterministically so the result is a bijection on [0, n_pad).
+    Every host computes the identical permutation from the exchanged
+    global counts; no further communication.
+    """
+    import heapq
+
+    n_entities = len(counts)
+    n_shards = n_hosts * d_local
+    n_pad = per_shard * n_shards
+    perm = np.empty(n_pad, np.int64)
+    free_slots: list[int] = []
+    for q in range(n_hosts):
+        ids = np.flatnonzero(owner == q)
+        order = ids[np.argsort(-counts[ids], kind="stable")]
+        if len(order) > d_local * per_shard:
+            raise ValueError(
+                f"host {q} owns {len(order)} entities > capacity "
+                f"{d_local * per_shard}"
+            )
+        heap = [(0, d) for d in range(d_local)]
+        used = np.zeros(d_local, np.int64)
+        for o in order:
+            load, d = heapq.heappop(heap)
+            perm[o] = (q * d_local + d) * per_shard + used[d]
+            used[d] += 1
+            if used[d] < per_shard:
+                heapq.heappush(heap, (load + int(counts[o]), d))
+        for d in range(d_local):
+            base_slot = (q * d_local + d) * per_shard
+            free_slots.extend(range(base_slot + used[d], base_slot + per_shard))
+    perm[n_entities:] = np.sort(np.array(free_slots, np.int64))
+    return perm
+
+
 def _bucket_boundaries(dmax: np.ndarray, chunk_budget: int) -> list:
     """Split a non-increasing per-local-id max-degree curve into
     (start, end, width) buckets: width = next multiple of 8 ≥ the bucket's
@@ -283,50 +331,61 @@ def _make_dense_blocks(
     n_entity_pad: int,
     n_shards: int,
     chunk_budget: int = None,
+    shard_range: tuple = None,
+    deg_global: np.ndarray = None,
 ) -> _DenseBlocks:
     """Build degree-bucketed dense rating matrices (host side).
 
     Requires per-shard-monotone degrees (apply the LPT or degree-sort
     permutation first).  All ratings of one entity land in one row of one
     bucket; the device half-step then needs no scatter at all.
+
+    Multi-host: ``shard_range=(s0, s1)`` fills matrices only for shards
+    [s0, s1) from THIS host's rows (the 1/N ingest path), with bucket
+    boundaries cut from ``deg_global`` — the full (n_shards, per_shard)
+    degree matrix every host derives from the exchanged global counts —
+    so all hosts compile the same program over different data.
     """
     chunk_budget = chunk_budget or _DENSE_CHUNK
     per_shard = n_entity_pad // n_shards
-    deg = np.bincount(entity, minlength=n_entity_pad).reshape(
-        n_shards, per_shard
+    local_deg = np.bincount(entity, minlength=n_entity_pad)
+    deg = (
+        deg_global
+        if deg_global is not None
+        else local_deg.reshape(n_shards, per_shard)
     )
     bounds = _bucket_boundaries(deg.max(axis=0), chunk_budget)
+    s0, s1 = shard_range if shard_range is not None else (0, n_shards)
 
     # sort triples by (shard, local id): each (shard, bucket) is then one
     # contiguous slice, and column position = rank within the entity
-    shard = entity // per_shard
-    order = np.lexsort((entity, shard))
+    order = np.argsort(entity, kind="stable")
     entity_s, other_s, rating_s = entity[order], other[order], rating[order]
     offsets = np.concatenate(
-        [[0], np.cumsum(deg.reshape(-1))]
-    )  # by global blocked id
+        [[0], np.cumsum(local_deg)]
+    )  # by global blocked id, over THIS host's rows
     pos = np.arange(len(entity_s)) - offsets[entity_s]
 
     idx_l, rat_l, msk_l, widths = [], [], [], []
     padded = 0
     for j0, j1, width in bounds:
         n_b = j1 - j0
-        idx_b = np.zeros((n_shards, n_b, width), np.int32)
-        rat_b = np.zeros((n_shards, n_b, width), np.float32)
-        msk_b = np.zeros((n_shards, n_b, width), np.float32)
-        for p in range(n_shards):
+        idx_b = np.zeros((s1 - s0, n_b, width), np.int32)
+        rat_b = np.zeros((s1 - s0, n_b, width), np.float32)
+        msk_b = np.zeros((s1 - s0, n_b, width), np.float32)
+        for p in range(s0, s1):
             s = offsets[p * per_shard + j0]
             e = offsets[p * per_shard + j1]
             rows = entity_s[s:e] - (p * per_shard + j0)
             cols = pos[s:e]
-            idx_b[p, rows, cols] = other_s[s:e]
-            rat_b[p, rows, cols] = rating_s[s:e]
-            msk_b[p, rows, cols] = 1.0
+            idx_b[p - s0, rows, cols] = other_s[s:e]
+            rat_b[p - s0, rows, cols] = rating_s[s:e]
+            msk_b[p - s0, rows, cols] = 1.0
         idx_l.append(idx_b)
         rat_l.append(rat_b)
         msk_l.append(msk_b)
         widths.append(width)
-        padded += n_shards * n_b * width
+        padded += (s1 - s0) * n_b * width
     return _DenseBlocks(
         idx=idx_l, rat=rat_l, msk=msk_l, widths=widths,
         per_shard=per_shard, padded_ratings=padded,
@@ -549,9 +608,19 @@ def _make_step(mesh, ub: _Blocks, ib: _Blocks, cfg: ALSConfig):
 
 
 def train_als(
-    ctx: MeshContext, interactions: Interactions, config: Optional[ALSConfig] = None
+    ctx: MeshContext, interactions, config: Optional[ALSConfig] = None
 ) -> ALSModel:
-    """Train factors over the mesh; returns a host-form ALSModel."""
+    """Train factors over the mesh; returns a host-form ALSModel.
+
+    ``interactions`` is either a full :class:`Interactions` (every host
+    holds all rows — the single-host path) or a
+    :class:`~predictionio_tpu.parallel.ingest.ShardedInteractions` (each
+    host read 1/N — the multi-host partitioned-ingest path).
+    """
+    from predictionio_tpu.parallel.ingest import ShardedInteractions
+
+    if isinstance(interactions, ShardedInteractions):
+        return _train_als_sharded(ctx, interactions, config or ALSConfig())
     cfg = config or ALSConfig()
     n_shards = ctx.axis_size(DATA_AXIS)
     n_users = interactions.n_users
@@ -696,6 +765,171 @@ def train_als(
         item_factors=V_host,
         user_map=interactions.user_map,
         item_map=interactions.item_map,
+        config=cfg,
+    )
+
+
+def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
+    """Multi-host partitioned-ingest training (SURVEY §7 "BiMap at scale").
+
+    Each host arrives with 1/N of the rows (``parallel/ingest.py``: its own
+    users' ratings + its own items' ratings, global ids, global degree
+    vectors). All relabeling and bucket geometry derive deterministically
+    from the exchanged global counts, so every host compiles the SAME
+    program and only the data differs; the factor matrices assemble from
+    process-local shards via ``jax.make_array_from_process_local_data``.
+    The only cross-host data movement is the opposite-factor all-gather
+    inside the step — XLA lays it on ICI/DCN (the Spark-shuffle role).
+    """
+    if cfg.solver != "dense":
+        raise ValueError("sharded multi-host training requires solver='dense'")
+    from predictionio_tpu.data.storage.base import PEvents
+
+    n_shards = ctx.axis_size(DATA_AXIS)
+    n_hosts = sh.num_processes
+    if n_shards % n_hosts:
+        raise ValueError(
+            f"{n_shards} device shards not divisible by {n_hosts} hosts"
+        )
+    d_local = n_shards // n_hosts
+    pid = sh.process_index
+
+    def side(id_map, counts):
+        inv = id_map.inverse
+        n = len(id_map)
+        owner = np.fromiter(
+            (PEvents.shard_hash(inv[i]) % n_hosts for i in range(n)),
+            np.int64, count=n,
+        )
+        # capacity: the fullest host's entities must fit its d_local shards
+        host_max = int(np.bincount(owner, minlength=n_hosts).max()) if n else 1
+        per_shard = max(1, -(-host_max // d_local))
+        n_pad = per_shard * n_shards
+        perm = _sharded_balance_permutation(
+            counts, owner, n_hosts, d_local, per_shard
+        )
+        deg = np.zeros(n_pad, np.int64)
+        deg[perm[:n]] = counts
+        return per_shard, n_pad, perm, deg.reshape(n_shards, per_shard)
+
+    per_u, n_users_pad, u_perm, deg_u = side(sh.user_map, sh.user_counts)
+    per_i, n_items_pad, i_perm, deg_i = side(sh.item_map, sh.item_counts)
+    my = (pid * d_local, (pid + 1) * d_local)
+
+    ub = _make_dense_blocks(
+        u_perm[sh.user_rows.user.astype(np.int64)],
+        i_perm[sh.user_rows.item.astype(np.int64)],
+        sh.user_rows.rating.astype(np.float32),
+        n_users_pad, n_shards, shard_range=my, deg_global=deg_u,
+    )
+    ib = _make_dense_blocks(
+        i_perm[sh.item_rows.item.astype(np.int64)],
+        u_perm[sh.item_rows.user.astype(np.int64)],
+        sh.item_rows.rating.astype(np.float32),
+        n_items_pad, n_shards, shard_range=my, deg_global=deg_i,
+    )
+
+    sh_rows = ctx.sharding(DATA_AXIS)
+    sharding = ctx.sharding(DATA_AXIS, None)
+
+    def put_local(b: _DenseBlocks):
+        bufs = []
+        for i in range(len(b.widths)):
+            for a in (b.idx[i], b.rat[i], b.msk[i]):
+                bufs.append(
+                    jax.make_array_from_process_local_data(sh_rows, a)
+                )
+        return tuple(bufs)
+
+    u_blocks, i_blocks = put_local(ub), put_local(ib)
+    step = _make_dense_step(ctx.mesh, ub, ib, cfg)
+
+    key = jax.random.PRNGKey(cfg.seed)
+    ku, kv = jax.random.split(key)
+    scale = 1.0 / np.sqrt(cfg.rank)
+
+    def place_rows(full_blocked: np.ndarray):
+        local = full_blocked[my[0] * full_blocked.shape[0] // n_shards
+                             : my[1] * full_blocked.shape[0] // n_shards]
+        return jax.make_array_from_process_local_data(sharding, local)
+
+    def init_factors(k, n_entities, n_pad, perm):
+        # drawn over ENTITIES only (not the padded layout) so the effective
+        # init — and thus the trained model — is identical for any host
+        # count / capacity; padding rows have no ratings, zeros are inert
+        base_draw = np.zeros((n_pad, cfg.rank), np.float32)
+        base_draw[:n_entities] = np.asarray(
+            jax.random.normal(k, (n_entities, cfg.rank), jnp.float32) * scale
+        )
+        return place_rows(base_draw[np.argsort(perm)])
+
+    U = init_factors(ku, sh.n_users, n_users_pad, u_perm)
+    V = init_factors(kv, sh.n_items, n_items_pad, i_perm)
+
+    start_iter = 0
+    manager = None
+    if cfg.checkpoint_dir:
+        from predictionio_tpu.core.checkpoint import (
+            CheckpointManager,
+            dataset_digest,
+            resume_from,
+            save_due,
+            validate_interval,
+        )
+
+        validate_interval(cfg.checkpoint_interval)
+        manager = CheckpointManager(cfg.checkpoint_dir)
+        # host-independent fingerprint: the global degree vectors stand in
+        # for the raw triples (every host computes the same value)
+        fingerprint = np.array(
+            [
+                n_users_pad, n_items_pad, int(sh.user_counts.sum()),
+                cfg.rank, int(cfg.implicit), cfg.seed,
+                # exchanged row digest (ingest.py): sensitive to pairings
+                # and rating VALUES — equal degree histograms with
+                # re-rated items must not resume each other's checkpoints
+                float(sh.dataset_digest),
+                dataset_digest(sh.user_counts, sh.item_counts),
+                float(cfg.reg), float(cfg.alpha),
+                2.0,  # layout tag: sharded-ingest dense blocking
+                n_shards, n_hosts,
+            ],
+            dtype=np.float64,
+        )
+        start_iter, state = resume_from(manager, fingerprint, cfg.iterations)
+        if state is not None:
+            U = place_rows(np.asarray(state["U"]))
+            V = place_rows(np.asarray(state["V"]))
+
+    for it in range(start_iter, cfg.iterations):
+        U, V = step(U, V, u_blocks, i_blocks)
+        if manager is not None:
+            from predictionio_tpu.core.checkpoint import save_due
+
+            if save_due(it + 1, cfg.checkpoint_interval, cfg.iterations):
+                state = {
+                    "U": device_get_global(U),
+                    "V": device_get_global(V),
+                    "fingerprint": fingerprint,
+                }
+                from predictionio_tpu.parallel import distributed
+
+                if distributed.should_write_storage():
+                    manager.save(it + 1, state)
+    U_all = device_get_global(U)
+    V_all = device_get_global(V)
+    from predictionio_tpu.parallel import distributed
+
+    if sh.cleanup is not None and distributed.should_write_storage():
+        # the final gather above is a collective: every host has finished
+        # its exchange long ago, so the rendezvous blobs can go
+        sh.cleanup()
+    n_users, n_items = sh.n_users, sh.n_items
+    return ALSModel(
+        user_factors=U_all[u_perm[:n_users]],
+        item_factors=V_all[i_perm[:n_items]],
+        user_map=sh.user_map,
+        item_map=sh.item_map,
         config=cfg,
     )
 

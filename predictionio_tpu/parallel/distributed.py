@@ -78,6 +78,22 @@ def process_index() -> int:
     return jax.process_index()
 
 
+def num_processes() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def run_id() -> Optional[str]:
+    """The launch-scoped unique id (set by ``pio launch`` on every worker).
+
+    Scopes cross-host rendezvous artifacts (e.g. the sharded-ingest map
+    exchange blobs, ``parallel/ingest.py``) so a crashed previous run's
+    leftovers can never be merged into a fresh run.
+    """
+    return os.environ.get("PIO_RUN_ID")
+
+
 def is_coordinator() -> bool:
     return process_index() == 0
 

@@ -1,0 +1,287 @@
+"""Sharded multi-host training ingest: 1/N reads with global id spaces.
+
+SURVEY.md §7's "BiMap at scale" hard part, solved without Spark: under the
+reference every executor reads its partition and the driver collects the
+``BiMap.stringInt`` id tables (``examples/.../ALSAlgorithm.scala`` via RDD
+collect); here every HOST reads 1/N of the event store with the DAO shard
+pushdown (``PEvents.find_interactions(shard=(p, N), shard_key=...)``,
+parity role ``JDBCPEvents.scala:35-119``) and the hosts rendezvous their
+small (entity → count) tables through the model-data repository — the
+storage layer doubles as the control plane, exactly the role the Spark
+driver's collect plays.
+
+Two read passes per host (2/N of the rows total):
+
+* **user pass** (``shard_key="entity"``): every rating of a user whose
+  ``crc32(user_id) % N == p`` — complete per-user row sets, what the
+  user-side blocked half-step needs.
+* **item pass** (``shard_key="target"``): the same keyed by item — the
+  item-side half-step's rows.
+
+The merged (sorted-string) union of the per-host tables gives every host
+an IDENTICAL global BiMap + degree vector, so downstream relabeling (LPT
+permutations, degree buckets) is deterministic across hosts with no
+further communication.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.data.batch import Interactions
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.storage import base as storage_base
+from predictionio_tpu.parallel import distributed
+
+logger = logging.getLogger(__name__)
+
+_BLOB_PREFIX = "__pio_shardmap__"
+
+
+@dataclasses.dataclass
+class ShardedInteractions:
+    """One host's view of a sharded training read.
+
+    Rows carry GLOBAL entity ids (valid across hosts); ``user_rows`` holds
+    the complete rating sets of this host's users, ``item_rows`` of its
+    items. ``user_counts``/``item_counts`` are global degree vectors
+    aligned with the global maps — identical on every host.
+    """
+
+    user_rows: Interactions
+    item_rows: Interactions
+    user_map: BiMap
+    item_map: BiMap
+    user_counts: np.ndarray
+    item_counts: np.ndarray
+    process_index: int
+    num_processes: int
+    # host-independent dataset digest (sum of per-host row digests, exchanged
+    # with the count tables): ties checkpoints to the actual triples — equal
+    # degree histograms with different ratings/pairings must NOT match
+    dataset_digest: int = 0
+    # invoked by the trainer on the coordinator after the final collective:
+    # removes the rendezvous blobs this read left in the model repo
+    cleanup: Optional[object] = None
+
+    @property
+    def n_users(self) -> int:
+        return len(self.user_map)
+
+    @property
+    def n_items(self) -> int:
+        return len(self.item_map)
+
+    def __len__(self) -> int:
+        # GLOBAL rating count (sanity checks gate on "no data", which must
+        # reflect the whole dataset, not this host's slice)
+        return int(self.user_counts.sum())
+
+
+def exchange_entity_tables(
+    storage,
+    key: str,
+    local_counts: dict,
+    process_index: int,
+    num_processes: int,
+    timeout: float = 300.0,
+    poll: float = 0.2,
+    local_digest: int = 0,
+) -> tuple[BiMap, np.ndarray, int]:
+    """Publish this host's (entity → count) table; return the global merge.
+
+    Every host inserts ``__pio_shardmap__<key>_<p>`` into the model-data
+    repository and polls until all N tables are present. Global ids are
+    ranks in sorted string order of the union — identical everywhere.
+    ``key`` MUST be launch-scoped (``pio launch`` exports a fresh
+    PIO_RUN_ID per invocation; when re-running ``--hosts`` rendered
+    commands, regenerate the id) so a crashed earlier run's blobs can
+    never be merged into a fresh run. ``local_digest`` rides along and
+    returns summed (mod 2⁴⁸) — a host-independent digest of the actual
+    rows for checkpoint fingerprints.
+    """
+    models = storage.get_model_data_models()
+    blob = json.dumps(
+        {"counts": local_counts, "digest": int(local_digest)}
+    ).encode()
+    models.insert(
+        storage_base.Model(f"{_BLOB_PREFIX}{key}_{process_index}", blob)
+    )
+    merged: dict = {}
+    digest = 0
+    deadline = time.monotonic() + timeout
+    for p in range(num_processes):
+        while True:
+            m = models.get(f"{_BLOB_PREFIX}{key}_{p}")
+            if m is not None:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shard-map exchange: table {p}/{num_processes} for "
+                    f"{key!r} never appeared (worker dead or storage not "
+                    "shared across hosts?)"
+                )
+            time.sleep(poll)
+        table = json.loads(m.models.decode())
+        for s, c in table["counts"].items():
+            merged[s] = merged.get(s, 0) + int(c)
+        digest = (digest + int(table.get("digest", 0))) % (1 << 48)
+    names = sorted(merged)
+    bimap = BiMap({s: i for i, s in enumerate(names)})
+    counts = np.array([merged[s] for s in names], dtype=np.int64)
+    return bimap, counts, digest
+
+
+def cleanup_exchange(storage, key: str, num_processes: int) -> None:
+    """Best-effort removal of one exchange's blobs."""
+    models = storage.get_model_data_models()
+    for p in range(num_processes):
+        try:
+            models.delete(f"{_BLOB_PREFIX}{key}_{p}")
+        except Exception:  # pragma: no cover - cleanup must never fail a run
+            pass
+
+
+def cleanup_exchange_keys(storage, run_key: str, num_processes: int) -> None:
+    """Remove ALL rendezvous blobs a sharded read left in the model repo.
+
+    The trainer invokes this through ``ShardedInteractions.cleanup`` on the
+    coordinator after its final collective — by then every host has long
+    finished its exchange (their training steps are collectives too), so
+    no poller can still need the blobs.
+    """
+    for suffix in ("_user", "_item", "_digest"):
+        cleanup_exchange(storage, run_key + suffix, num_processes)
+
+
+def _translate(inter: Interactions, user_map: BiMap, item_map: BiMap):
+    """Re-express local dictionary codes in the global id space."""
+
+    def lut(local_map: BiMap, global_map: BiMap) -> np.ndarray:
+        inv = local_map.inverse
+        return np.array(
+            [global_map[inv[i]] for i in range(len(local_map))], np.int32
+        )
+
+    u = lut(inter.user_map, user_map)[inter.user] if len(inter.user) else inter.user
+    i = lut(inter.item_map, item_map)[inter.item] if len(inter.item) else inter.item
+    return Interactions(
+        user=u.astype(np.int32),
+        item=i.astype(np.int32),
+        rating=inter.rating,
+        t=inter.t,
+        user_map=user_map,
+        item_map=item_map,
+    )
+
+
+def _count_table(codes: np.ndarray, id_map: BiMap) -> dict:
+    counts = np.bincount(codes, minlength=len(id_map))
+    inv = id_map.inverse
+    return {inv[i]: int(c) for i, c in enumerate(counts)}
+
+
+def read_sharded_interactions(
+    storage,
+    app_id: int,
+    run_key: Optional[str] = None,
+    process_index: Optional[int] = None,
+    num_processes: Optional[int] = None,
+    channel_id: Optional[int] = None,
+    parts: Optional[list] = None,
+    **find_kwargs,
+) -> ShardedInteractions:
+    """The 1/N-per-host training read (two entity-keyed passes + exchange).
+
+    ``find_kwargs`` are the usual ``find_interactions`` filters
+    (entity_type, event_names, target_entity_type, rating_key, ...).
+    ``parts`` instead passes SEVERAL filter dicts whose results merge
+    row-wise before the exchange — the rate+buy multi-read the templates
+    perform, still at 1/N rows per pass.
+    """
+    from predictionio_tpu.data.batch import merge_interactions
+
+    pid = (
+        process_index
+        if process_index is not None
+        else distributed.process_index()
+    )
+    n = (
+        num_processes
+        if num_processes is not None
+        else distributed.num_processes()
+    )
+    key = run_key or distributed.run_id()
+    if key is None:
+        raise RuntimeError(
+            "sharded ingest needs a launch-scoped run id: launch workers "
+            "via `pio launch` (exports PIO_RUN_ID) or pass run_key="
+        )
+    pe = storage.get_p_events()
+    part_kwargs = parts if parts is not None else [find_kwargs]
+
+    def read_pass(shard_key: str) -> Interactions:
+        reads = [
+            pe.find_interactions(
+                app_id, channel_id=channel_id, shard=(pid, n),
+                shard_key=shard_key, **p,
+            )
+            for p in part_kwargs
+        ]
+        reads = [r for r in reads if len(r.rating)] or reads[:1]
+        return reads[0] if len(reads) == 1 else merge_interactions(reads)
+
+    upass = read_pass("entity")
+    ipass = read_pass("target")
+    # the user pass holds ALL rows of my users (counts complete); same for
+    # the item pass by items — so the merged tables are exact global degrees
+    user_map, user_counts, _ = exchange_entity_tables(
+        storage, key + "_user", _count_table(upass.user, upass.user_map),
+        pid, n,
+    )
+    item_map, item_counts, _ = exchange_entity_tables(
+        storage, key + "_item", _count_table(ipass.item, ipass.item_map),
+        pid, n,
+    )
+    logger.info(
+        "sharded ingest p%d/%d: %d user-pass + %d item-pass rows of "
+        "%d global ratings (%.1f%%)",
+        pid, n, len(upass.rating), len(ipass.rating), int(user_counts.sum()),
+        100.0 * (len(upass.rating) + len(ipass.rating))
+        / max(1, 2 * int(user_counts.sum())),
+    )
+    user_rows = _translate(upass, user_map, item_map)
+    item_rows = _translate(ipass, user_map, item_map)
+    # host-independent row digest for checkpoint fingerprints: one
+    # vectorized sha1 over THIS host's translated triples (global ids are
+    # layout-stable and the DAO scan order is deterministic), summed
+    # across hosts through a digest exchange. Sensitive to pairings and
+    # rating values — equal degree histograms must not collide.
+    from predictionio_tpu.core.checkpoint import dataset_digest
+
+    local_digest = (
+        dataset_digest(user_rows.user, user_rows.item, user_rows.rating)
+        if len(user_rows.rating)
+        else 0
+    )
+    _, _, row_digest = exchange_entity_tables(
+        storage, key + "_digest", {}, pid, n, local_digest=local_digest
+    )
+    return ShardedInteractions(
+        user_rows=user_rows,
+        item_rows=item_rows,
+        user_map=user_map,
+        item_map=item_map,
+        user_counts=user_counts,
+        item_counts=item_counts,
+        process_index=pid,
+        num_processes=n,
+        dataset_digest=row_digest,
+        cleanup=lambda: cleanup_exchange_keys(storage, key, n),
+    )

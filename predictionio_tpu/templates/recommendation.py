@@ -106,49 +106,84 @@ class RecommendationDataSource(SelfCleaningDataSource, DataSource):
 
     BUY_WEIGHT = 4.0  # parity: buy events count as rating 4.0
 
-    def _read_interactions(self) -> Interactions:
-        # one columnar read per event type (fast path on parquet), merged
-        # with shared id maps; buys weigh BUY_WEIGHT like the reference
-        parts = []
+    def _part_filters(self) -> list[dict]:
+        """The per-event-type read specs (rate+buy default, or the
+        eventRatings custom mapping)."""
         if self.params.eventRatings:
-            for name, value in self.params.eventRatings.items():
-                part = PEventStore.find_interactions(
-                    self.params.appName,
+            return [
+                dict(
                     entity_type="user",
                     event_names=[name],
                     target_entity_type="item",
                     default_rating=float(value),
                 )
-                if len(part):
-                    parts.append(part)
-            if not parts:
-                return part  # empty Interactions with empty maps
-            return merge_interactions(parts)
-        rate = PEventStore.find_interactions(
-            self.params.appName,
-            entity_type="user",
-            event_names=["rate"],
-            target_entity_type="item",
-            rating_key="rating",
-            default_rating=self.BUY_WEIGHT,
-        )
-        if len(rate):
-            parts.append(rate)
-        buy = PEventStore.find_interactions(
-            self.params.appName,
-            entity_type="user",
-            event_names=["buy"],
-            target_entity_type="item",
-            default_rating=self.BUY_WEIGHT,
-        )
-        if len(buy):
-            parts.append(buy)
-        if not parts:
-            return rate  # empty Interactions with empty maps
-        return merge_interactions(parts)
+                for name, value in self.params.eventRatings.items()
+            ]
+        return [
+            dict(
+                entity_type="user",
+                event_names=["rate"],
+                target_entity_type="item",
+                rating_key="rating",
+                default_rating=self.BUY_WEIGHT,
+            ),
+            dict(
+                entity_type="user",
+                event_names=["buy"],
+                target_entity_type="item",
+                default_rating=self.BUY_WEIGHT,
+            ),
+        ]
 
-    def read_training(self, ctx) -> TrainingData:
+    def _read_interactions(self) -> Interactions:
+        # one columnar read per event type (fast path on parquet), merged
+        # with shared id maps; buys weigh BUY_WEIGHT like the reference
+        parts = []
+        part = None
+        for spec in self._part_filters():
+            part = PEventStore.find_interactions(self.params.appName, **spec)
+            if len(part):
+                parts.append(part)
+        if not parts:
+            return part  # empty Interactions with empty maps
+        return merge_interactions(parts) if len(parts) > 1 else parts[0]
+
+    def read_training(self, ctx):
+        from predictionio_tpu.parallel import distributed
+
+        multihost = (
+            distributed.is_initialized() and distributed.num_processes() > 1
+        )
+        if multihost and self.params.eventWindow:
+            # the window cleaner REWRITES the event store in place
+            # (coordinator-only), which would race the other hosts'
+            # sharded reads — there is no cross-host barrier here, so
+            # refuse loudly rather than silently train on partial data
+            raise ValueError(
+                "eventWindow cleaning is not supported under multi-host "
+                "launch: run `pio train` single-host to compact, then "
+                "launch without eventWindow"
+            )
         self.clean_persisted_events()  # no-op without an eventWindow param
+        if multihost:
+            # multi-host launch: each host ingests 1/N of the event store
+            # with entity-keyed pushdown and the hosts exchange id tables
+            # through the model repo (SURVEY §7 "BiMap at scale";
+            # parallel/ingest.py). The trainer consumes the sharded form.
+            from predictionio_tpu.data.store import get_storage, resolve_app
+            from predictionio_tpu.parallel.ingest import (
+                read_sharded_interactions,
+            )
+
+            app_id, channel_id = resolve_app(self.params.appName)
+            return TrainingData(
+                read_sharded_interactions(
+                    get_storage(),
+                    app_id,
+                    channel_id=channel_id,
+                    parts=self._part_filters(),
+                )
+            )
         return TrainingData(self._read_interactions())
 
     def read_eval(self, ctx):
@@ -209,6 +244,14 @@ class ExcludeItemsPreparator(Preparator):
         path = getattr(self.params, "filepath", None)
         if not path:
             return td
+        from predictionio_tpu.parallel.ingest import ShardedInteractions
+
+        if isinstance(td.interactions, ShardedInteractions):
+            raise ValueError(
+                "ExcludeItemsPreparator filepath is not supported with "
+                "sharded multi-host ingest; filter items in the datasource "
+                "events or train single-host"
+            )
         with open(path) as f:
             no_train = {line.strip() for line in f if line.strip()}
         if not no_train:

@@ -30,6 +30,7 @@ import shlex
 import subprocess
 import sys
 import threading
+import uuid
 from typing import Optional, Sequence
 
 WORKER_PREFIX = "[p{index}] "
@@ -40,6 +41,7 @@ def worker_env(
     coordinator: str,
     num_processes: int,
     process_id: int,
+    run_id: Optional[str] = None,
 ) -> dict:
     env = dict(base_env)
     env.update(
@@ -49,6 +51,10 @@ def worker_env(
             "PIO_PROCESS_ID": str(process_id),
         }
     )
+    if run_id is not None:
+        # launch-scoped id shared by every worker: scopes cross-host
+        # rendezvous artifacts (sharded-ingest map exchange) per run
+        env["PIO_RUN_ID"] = run_id
     return env
 
 
@@ -79,12 +85,13 @@ def launch_local(
     out = out or sys.stdout
     base = dict(env if env is not None else os.environ)
     coordinator = f"127.0.0.1:{coordinator_port}"
+    run = uuid.uuid4().hex[:12]
     procs: list[subprocess.Popen] = []
     pumps: list[threading.Thread] = []
     for i in range(num_processes):
         p = subprocess.Popen(
             [sys.executable, "-m", "predictionio_tpu.tools.cli", *pio_args],
-            env=worker_env(base, coordinator, num_processes, i),
+            env=worker_env(base, coordinator, num_processes, i, run_id=run),
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -125,12 +132,19 @@ def render_host_commands(
     """Per-host command lines; hosts[0] is the coordinator."""
     coordinator = f"{hosts[0]}:{coordinator_port}"
     quoted = " ".join(shlex.quote(a) for a in pio_args)
-    lines = []
+    run = uuid.uuid4().hex[:12]
+    lines = [
+        "# PIO_RUN_ID scopes the run's cross-host rendezvous state; it must "
+        "be IDENTICAL on every host\n"
+        "# and FRESH per launch attempt — re-render (or substitute a new "
+        "shared id) before re-running."
+    ]
     for i, host in enumerate(hosts):
         lines.append(
             f"# on {host}:\n"
             f"PIO_COORDINATOR={coordinator} "
             f"PIO_NUM_PROCESSES={len(hosts)} "
-            f"PIO_PROCESS_ID={i} pio {quoted}"
+            f"PIO_PROCESS_ID={i} "
+            f"PIO_RUN_ID={run} pio {quoted}"
         )
     return lines

@@ -167,7 +167,7 @@ print("seeded", len(events))
         [
             sys.executable, "-m", "predictionio_tpu.tools.cli", "launch",
             "--num-processes", "2", "--coordinator-port", str(port),
-            "--", "train",
+            "--", "--verbose", "train",
         ],
         env=env, cwd=str(tmp_path), capture_output=True, text=True,
         timeout=300,
@@ -176,6 +176,24 @@ print("seeded", len(events))
     assert "all 2 processes completed" in r.stdout
     # both workers' output is attributable
     assert "[p0] " in r.stdout and "[p1] " in r.stdout
+
+    # partitioned ingest (VERDICT r3 item 5): each worker must have read a
+    # PROPER 1/N slice of the event store, and the slices must cover it
+    import re
+
+    scans = {
+        int(m.group(1)): (int(m.group(2)), int(m.group(3)), int(m.group(4)))
+        for m in re.finditer(
+            r"sharded ingest p(\d)/2: (\d+) user-pass \+ (\d+) item-pass "
+            r"rows of (\d+) global ratings",
+            r.stdout,
+        )
+    }
+    assert set(scans) == {0, 1}, r.stdout
+    total = scans[0][2]
+    assert scans[0][0] + scans[1][0] == total  # user passes cover all rows
+    assert scans[0][1] + scans[1][1] == total  # item passes cover all rows
+    assert 0 < scans[0][0] < total and 0 < scans[1][0] < total
 
     check = tmp_path / "check.py"
     check.write_text(
