@@ -118,7 +118,18 @@ class CheckpointManager:
         return os.path.join(self.directory, f"step_{step}.fp.npy")
 
     def save(self, step: int, tree: Any) -> None:
+        """Persist a step. Multi-host: EVERY process must call this — the
+        orbax write is a collective (it runs sync_global_devices barriers;
+        gating it to the coordinator deadlocks the job). orbax itself
+        writes host arrays once; the sidecar and retention file ops below
+        are plain filesystem writes, so those ARE coordinator-gated to
+        keep a shared checkpoint_dir single-writer.
+        """
+        from predictionio_tpu.parallel import distributed
+
         save_pytree(self._step_dir(step), tree)
+        if not distributed.should_write_storage():
+            return
         # fingerprint sidecar: resume_from can reject a non-matching step
         # without restoring its full (possibly multi-GB) state
         if isinstance(tree, dict) and tree.get("fingerprint") is not None:
@@ -177,6 +188,10 @@ def resume_from(manager: CheckpointManager, fingerprint, max_step: int):
         state = manager.restore(step)  # host pytree
         got = np.asarray(state.get("fingerprint"))
         if got.shape == want.shape and np.allclose(got, want):
+            logger.info(
+                "resuming from checkpoint step %d under %s",
+                step, manager.directory,
+            )
             return step, state
         logger.warning(
             "checkpoint step %d under %s does not match this config/dataset; "

@@ -66,18 +66,15 @@ def serialize_models(
     Each slot is one of ``("pickle", blob)``, ``("manifest", class_path)`` or
     ``("retrain", None)``.
     """
-    from predictionio_tpu.parallel import distributed
-
     slots = []
     for algo, model, params in zip(algorithms, models, algo_params):
         if isinstance(model, PersistentModel):
-            # multi-host: only the coordinator performs the manifest-mode
-            # file write; other processes emit the same (host-form) slot
-            # without side effects. PersistentModel models are host-form
-            # by contract, so skipping save() here is not a collective.
-            if not distributed.should_write_storage():
-                slots.append(("manifest", class_path(model)))
-                continue
+            # multi-host: EVERY process calls save() — implementations that
+            # persist through save_pytree run an orbax collective (which
+            # barriers across hosts and writes once), so gating the call to
+            # the coordinator would deadlock the job. Implementations gate
+            # their own non-collective file writes (e.g. the id-map pickle
+            # in CheckpointedALSModel.save) to stay single-writer.
             if model.save(instance_id, params):
                 slots.append(("manifest", class_path(model)))
             else:

@@ -396,6 +396,22 @@ class Models(abc.ABC):
     def delete(self, model_id: str) -> None: ...
 
 
+class Sequences(abc.ABC):
+    """Named monotonic id-allocation service.
+
+    Parity: ``ESSequences.scala`` (``storage/elasticsearch/src/main/scala/
+    org/apache/predictionio/data/storage/elasticsearch/ESSequences.scala``)
+    — the reference's shared counter behind app/event id generation when
+    the metadata store is Elasticsearch. ``gen_next`` is atomic per name:
+    concurrent callers (threads or hosts via the network driver) never
+    observe the same value twice.
+    """
+
+    @abc.abstractmethod
+    def gen_next(self, name: str) -> int:
+        """The next value of counter ``name`` (first call returns 1)."""
+
+
 class Apps(abc.ABC):
     @abc.abstractmethod
     def insert(self, app: App) -> Optional[int]:

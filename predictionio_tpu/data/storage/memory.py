@@ -34,6 +34,7 @@ class _Store:
         self.engine_instances: dict[str, base.EngineInstance] = {}
         self.evaluation_instances: dict[str, base.EvaluationInstance] = {}
         self.seq = itertools.count(1)
+        self.sequences: dict[str, int] = {}
 
 
 _STORES: dict[str, _Store] = {}
@@ -206,6 +207,17 @@ class MemoryModels(base.Models):
     def delete(self, model_id: str) -> None:
         with self._s.lock:
             self._s.models.pop(model_id, None)
+
+
+class MemorySequences(base.Sequences):
+    def __init__(self, source_name: str = "default", **_):
+        self._s = get_store(source_name)
+
+    def gen_next(self, name: str) -> int:
+        with self._s.lock:
+            nxt = self._s.sequences.get(name, 0) + 1
+            self._s.sequences[name] = nxt
+            return nxt
 
 
 class MemoryApps(base.Apps):

@@ -621,6 +621,11 @@ _META_HANDLERS = {
         _instance_from_wire(base.EvaluationInstance, a["instance"])
     ),
     ("evaluationinstances", "delete"): lambda s, a: _ev(s).delete(a["instance_id"]),
+    # Sequences (ESSequences role): the backing DAO's atomicity makes the
+    # networked counter cluster-wide — every client sees a unique value
+    ("sequences", "gen_next"): lambda s, a: s.get_meta_data_sequences().gen_next(
+        a["name"]
+    ),
 }
 
 
@@ -957,6 +962,13 @@ class _MetaClient:
 
     def _call(self, method: str, **args):
         return self._c.call(f"/meta/{self.dao}/{method}", args)
+
+
+class NetworkSequences(_MetaClient, base.Sequences):
+    dao = "sequences"
+
+    def gen_next(self, name: str) -> int:
+        return int(self._call("gen_next", name=name))
 
 
 class NetworkApps(_MetaClient, base.Apps):

@@ -54,6 +54,7 @@ def _register_builtin():
             "Channels": memory.MemoryChannels,
             "EngineInstances": memory.MemoryEngineInstances,
             "EvaluationInstances": memory.MemoryEvaluationInstances,
+            "Sequences": memory.MemorySequences,
         },
     )
     sqlite_daos = {
@@ -65,6 +66,7 @@ def _register_builtin():
         "Channels": sqlite.SqliteChannels,
         "EngineInstances": sqlite.SqliteEngineInstances,
         "EvaluationInstances": sqlite.SqliteEvaluationInstances,
+        "Sequences": sqlite.SqliteSequences,
     }
     register_driver("sqlite", sqlite_daos)
     register_driver("localfs", {"Models": localfs.LocalFSModels})
@@ -86,6 +88,7 @@ def _register_builtin():
             "Channels": network.NetworkChannels,
             "EngineInstances": network.NetworkEngineInstances,
             "EvaluationInstances": network.NetworkEvaluationInstances,
+            "Sequences": network.NetworkSequences,
         },
     )
     import importlib.util
@@ -228,6 +231,10 @@ class Storage:
 
     def get_meta_data_evaluation_instances(self) -> base.EvaluationInstances:
         return self.get_data_object(METADATA, "EvaluationInstances")
+
+    def get_meta_data_sequences(self) -> base.Sequences:
+        """Named monotonic counters (parity: ESSequences.scala role)."""
+        return self.get_data_object(METADATA, "Sequences")
 
     # -- smoke check (parity: Storage.verifyAllDataObjects:372-394) --------
     def verify_all_data_objects(self) -> bool:

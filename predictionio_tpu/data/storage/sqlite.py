@@ -54,6 +54,8 @@ CREATE TABLE IF NOT EXISTS evaluation_instances (
   env TEXT, mesh_conf TEXT, evaluator_results TEXT,
   evaluator_results_html TEXT, evaluator_results_json TEXT);
 CREATE TABLE IF NOT EXISTS models (id TEXT PRIMARY KEY, models BLOB NOT NULL);
+CREATE TABLE IF NOT EXISTS sequences (
+  name TEXT PRIMARY KEY, value INTEGER NOT NULL);
 """
 
 _CONNS: dict[str, "_Db"] = {}
@@ -418,6 +420,32 @@ class SqliteModels(_SqliteDAO, base.Models):
         with self.lock:
             self.conn.execute("DELETE FROM models WHERE id = ?", (model_id,))
             self.conn.commit()
+
+
+class SqliteSequences(_SqliteDAO, base.Sequences):
+    """Parity: ESSequences.scala — atomic named counters.
+
+    INSERT OR IGNORE + UPDATE + SELECT inside one transaction (no
+    ``RETURNING``, which needs SQLite ≥ 3.35 — 2021 — and would crash on
+    older bundled libraries): the process lock serializes threads, the
+    transaction serializes other processes on the shared file.
+    """
+
+    def gen_next(self, name: str) -> int:
+        with self.lock:
+            self.conn.execute(
+                "INSERT OR IGNORE INTO sequences (name, value) VALUES (?, 0)",
+                (name,),
+            )
+            self.conn.execute(
+                "UPDATE sequences SET value = value + 1 WHERE name = ?",
+                (name,),
+            )
+            row = self.conn.execute(
+                "SELECT value FROM sequences WHERE name = ?", (name,)
+            ).fetchone()
+            self.conn.commit()
+        return int(row[0])
 
 
 class SqliteApps(_SqliteDAO, base.Apps):

@@ -741,19 +741,16 @@ def train_als(
         if manager is not None and save_due(
             it + 1, cfg.checkpoint_interval, cfg.iterations
         ):
-            # gather on ALL processes (collective), write on the
-            # coordinator only — a shared checkpoint_dir must not take
-            # concurrent writers; resume requires it be shared across
-            # hosts (docs/operations.md multi-host section)
+            # gather AND save on every process: both are collectives (the
+            # orbax write barriers across hosts and writes once; gating it
+            # to the coordinator deadlocks). The checkpoint_dir must be
+            # shared across hosts (docs/operations.md multi-host section).
             state = {
                 "U": device_get_global(U),
                 "V": device_get_global(V),
                 "fingerprint": fingerprint,
             }
-            from predictionio_tpu.parallel import distributed
-
-            if distributed.should_write_storage():
-                manager.save(it + 1, state)
+            manager.save(it + 1, state)
     U_all = device_get_global(U)
     V_all = device_get_global(V)
     # factor row new_id belongs to old entity id o with perm[o] == new_id;
@@ -907,15 +904,14 @@ def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
             from predictionio_tpu.core.checkpoint import save_due
 
             if save_due(it + 1, cfg.checkpoint_interval, cfg.iterations):
+                # every process gathers AND saves: both are collectives
+                # (orbax's write barriers across hosts and writes once)
                 state = {
                     "U": device_get_global(U),
                     "V": device_get_global(V),
                     "fingerprint": fingerprint,
                 }
-                from predictionio_tpu.parallel import distributed
-
-                if distributed.should_write_storage():
-                    manager.save(it + 1, state)
+                manager.save(it + 1, state)
     U_all = device_get_global(U)
     V_all = device_get_global(V)
     from predictionio_tpu.parallel import distributed
@@ -958,19 +954,24 @@ class CheckpointedALSModel(ALSModel):
         import pickle
 
         from predictionio_tpu.core.checkpoint import save_pytree
+        from predictionio_tpu.parallel import distributed
 
         d = self._dir(instance_id)
         os.makedirs(d, exist_ok=True)
+        # collective: every process must reach this call (orbax barriers
+        # across hosts and writes once); the plain pickle below is an
+        # ordinary file write and stays coordinator-only
         save_pytree(
             os.path.join(d, "factors"),
             {"user_factors": self.user_factors, "item_factors": self.item_factors},
         )
-        with open(os.path.join(d, "maps.pkl"), "wb") as f:
-            pickle.dump(
-                {"user_map": self.user_map, "item_map": self.item_map,
-                 "config": self.config},
-                f,
-            )
+        if distributed.should_write_storage():
+            with open(os.path.join(d, "maps.pkl"), "wb") as f:
+                pickle.dump(
+                    {"user_map": self.user_map, "item_map": self.item_map,
+                     "config": self.config},
+                    f,
+                )
         return True  # manifest mode: MODELDATA stores only the class path
 
     @classmethod

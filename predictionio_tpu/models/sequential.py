@@ -506,8 +506,9 @@ def train_sasrec(
         if manager is not None and save_due(
             epoch + 1, cfg.checkpoint_interval, cfg.epochs
         ):
-            # gather on ALL processes (ctx.to_host all-gathers spanning
-            # shards — a collective), write on the coordinator only
+            # gather AND save on every process: both are collectives (the
+            # orbax write barriers across hosts and writes once; gating it
+            # to the coordinator deadlocks the other hosts)
             state = ctx.to_host(
                 {
                     "params": params,
@@ -515,10 +516,7 @@ def train_sasrec(
                     "fingerprint": fingerprint,
                 }
             )
-            from predictionio_tpu.parallel import distributed
-
-            if distributed.should_write_storage():
-                manager.save(epoch + 1, state)
+            manager.save(epoch + 1, state)
     return SASRecModel(
         params=ctx.to_host(params), item_map=interactions.item_map, config=cfg
     )

@@ -58,6 +58,15 @@ def initialize(
         process_id=process_id,
     )
     _initialized = True
+    # Establish the cross-process collective context NOW, while every
+    # process is still synchronized from the rendezvous. The backend's
+    # context handshake (Gloo on CPU) has a short deadline; if the first
+    # collective instead fires after a heavy per-process XLA compile,
+    # compile-time skew between hosts can exceed it and kill the job
+    # with "context initialization failed".
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("pio:distributed-init")
     logger.info(
         "jax.distributed initialized: process %d/%d via %s; %d global devices",
         process_id,

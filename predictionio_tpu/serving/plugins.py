@@ -1,0 +1,78 @@
+"""Plugin auto-discovery — the ServiceLoader role.
+
+The reference discovers engine-server and event-server plugins from the
+classpath via ``java.util.ServiceLoader``
+(``core/src/main/scala/org/apache/predictionio/workflow/
+EngineServerPluginContext.scala:34-97``): dropping a jar on the classpath
+registers its plugins with no flags. The Python-native equivalent is
+package entry points: an installed plugin package declares
+
+    [project.entry-points."predictionio_tpu.plugins"]
+    my-blocker = my_pkg.plugins:MyBlocker
+
+and it appears in ``/plugins.json`` on the next deploy with no CLI flag.
+``PIO_PLUGINS`` (comma-separated dotted paths) covers environments where
+installing a distribution isn't possible, and ``--plugin`` stays as the
+explicit per-invocation override. Event-server plugins use the
+``predictionio_tpu.event_plugins`` group.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+ENGINE_GROUP = "predictionio_tpu.plugins"
+EVENT_GROUP = "predictionio_tpu.event_plugins"
+
+
+def discover_plugins(group: str = ENGINE_GROUP) -> list:
+    """Instantiate every plugin advertised for ``group``.
+
+    Sources, in order: installed-package entry points, then the
+    ``PIO_PLUGINS`` env var. A plugin that fails to load is logged and
+    skipped — one broken package must not take the server down with it
+    (the reference's ServiceLoader behaves the same way).
+    """
+    out = []
+    from importlib import metadata
+
+    try:
+        eps = metadata.entry_points()
+        selected = (
+            eps.select(group=group)
+            if hasattr(eps, "select")
+            else eps.get(group, [])  # pre-3.10 mapping API
+        )
+        for ep in selected:
+            try:
+                out.append(ep.load()())
+            except Exception:
+                logger.exception(
+                    "plugin entry point %r (%s) failed to load; skipping",
+                    ep.name, group,
+                )
+    except Exception:
+        logger.exception("entry-point scan failed; continuing without")
+    if group == ENGINE_GROUP:
+        from predictionio_tpu.core.persistence import resolve_class
+
+        seen = {type(p) for p in out}
+        for path in (os.environ.get("PIO_PLUGINS") or "").split(","):
+            path = path.strip()
+            if not path:
+                continue
+            try:
+                plugin = resolve_class(path)()
+            except Exception:
+                logger.exception(
+                    "PIO_PLUGINS entry %r failed to load; skipping", path
+                )
+                continue
+            # a plugin advertised BOTH ways (installed entry point + a
+            # leftover PIO_PLUGINS entry) must run once, not twice
+            if type(plugin) not in seen:
+                out.append(plugin)
+    return out

@@ -82,11 +82,20 @@ def make_ctx(variant: dict):
     return MeshContext.create(conf=conf)
 
 
-def load_plugins(paths: list[str]) -> list:
-    """--plugin dotted.path.Class → instances (ServiceLoader replacement)."""
+def load_plugins(paths: list[str], group: Optional[str] = None) -> list:
+    """Explicit ``--plugin dotted.path.Class`` instances + auto-discovered
+    entry-point/PIO_PLUGINS plugins (the ServiceLoader role,
+    EngineServerPluginContext.scala:34-97 — serving/plugins.py)."""
     from predictionio_tpu.core.persistence import resolve_class
+    from predictionio_tpu.serving.plugins import ENGINE_GROUP, discover_plugins
 
-    return [resolve_class(p)() for p in paths or []]
+    explicit = [resolve_class(p)() for p in paths or []]
+    seen = {type(p) for p in explicit}
+    return explicit + [
+        p
+        for p in discover_plugins(group or ENGINE_GROUP)
+        if type(p) not in seen
+    ]
 
 
 BUILTIN_TEMPLATES = {
@@ -427,8 +436,11 @@ def cmd_shell(args) -> int:
 def cmd_eventserver(args) -> int:
     from predictionio_tpu.data.api.event_server import EventServer
 
+    from predictionio_tpu.serving.plugins import EVENT_GROUP
+
     es = EventServer(
-        storage=_storage(), stats=args.stats, plugins=load_plugins(args.plugin)
+        storage=_storage(), stats=args.stats,
+        plugins=load_plugins(args.plugin, group=EVENT_GROUP),
     )
     port = es.start(args.ip, args.port, cert_path=args.cert_path,
                     key_path=args.key_path)

@@ -1,0 +1,141 @@
+"""Entry-point plugin auto-discovery (the ServiceLoader role).
+
+Parity: EngineServerPluginContext.scala:34-97 — a drop-in package
+registers its plugins with no CLI flag. The test builds a REAL installed
+distribution (dist-info + module on sys.path) so importlib.metadata
+discovers it exactly as pip-installed packages are.
+"""
+
+import sys
+import textwrap
+
+import pytest
+
+
+@pytest.fixture()
+def fake_dist(tmp_path, monkeypatch):
+    """A minimal installed distribution advertising one plugin."""
+    site = tmp_path / "site"
+    site.mkdir()
+    (site / "fakeplug.py").write_text(textwrap.dedent("""
+        from predictionio_tpu.serving.query_server import EngineServerPlugin
+
+        class TagBlocker(EngineServerPlugin):
+            name = "tag-blocker"
+            plugin_type = EngineServerPlugin.OUTPUT_BLOCKER
+
+            def process(self, query, prediction, context):
+                prediction["tagged"] = True
+                return prediction
+
+        class Broken(EngineServerPlugin):
+            name = "broken"
+            def __init__(self):
+                raise RuntimeError("boom")
+    """))
+    dist = site / "fakeplug-0.1.dist-info"
+    dist.mkdir()
+    (dist / "METADATA").write_text("Metadata-Version: 2.1\nName: fakeplug\nVersion: 0.1\n")
+    (dist / "entry_points.txt").write_text(
+        "[predictionio_tpu.plugins]\n"
+        "tag-blocker = fakeplug:TagBlocker\n"
+        "broken = fakeplug:Broken\n"
+    )
+    monkeypatch.syspath_prepend(str(site))
+    yield site
+    sys.modules.pop("fakeplug", None)
+
+
+class TestDiscovery:
+    def test_entry_point_plugin_discovered(self, fake_dist):
+        from predictionio_tpu.serving.plugins import discover_plugins
+
+        names = [p.name for p in discover_plugins()]
+        assert "tag-blocker" in names
+        # the broken plugin is skipped, not fatal (ServiceLoader behavior)
+        assert "broken" not in names
+
+    def test_pio_plugins_env(self, monkeypatch):
+        from predictionio_tpu.serving.plugins import discover_plugins
+
+        monkeypatch.setenv(
+            "PIO_PLUGINS",
+            "predictionio_tpu.serving.query_server.EngineServerPlugin",
+        )
+        kinds = [type(p).__name__ for p in discover_plugins()]
+        assert "EngineServerPlugin" in kinds
+
+    def test_cli_load_plugins_dedups_explicit(self, fake_dist):
+        from predictionio_tpu.tools.cli import load_plugins
+
+        plugins = load_plugins(["fakeplug.TagBlocker"])
+        assert [type(p).__name__ for p in plugins].count("TagBlocker") == 1
+
+    def test_appears_in_plugins_json_without_flag(self, fake_dist, storage):
+        """The reference's deployment story: install a package, deploy with
+        no flags, see the plugin on /plugins.json and in effect."""
+        import json
+        import urllib.request
+
+        import numpy as np
+
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.data import Event
+        from predictionio_tpu.data import store as store_mod
+        from predictionio_tpu.data.storage import App
+        from predictionio_tpu.parallel.mesh import MeshContext
+        from predictionio_tpu.serving.query_server import QueryServer
+        from predictionio_tpu.templates.recommendation import (
+            RecommendationEngine,
+        )
+        from predictionio_tpu.tools.cli import load_plugins
+
+        store_mod.set_storage(storage)
+        try:
+            app_id = storage.get_meta_data_apps().insert(App(0, "plugapp"))
+            le = storage.get_l_events()
+            le.init(app_id)
+            rng = np.random.default_rng(3)
+            le.batch_insert(
+                [
+                    Event(
+                        event="rate", entity_type="user", entity_id=f"u{u}",
+                        target_entity_type="item", target_entity_id=f"i{i}",
+                        properties={"rating": float(rng.integers(1, 6))},
+                    )
+                    for u in range(12)
+                    for i in rng.choice(10, 4, replace=False)
+                ],
+                app_id,
+            )
+            engine = RecommendationEngine.apply()
+            ep = engine.params_from_variant({
+                "datasource": {"params": {"appName": "plugapp"}},
+                "algorithms": [
+                    {"name": "als", "params": {"rank": 3, "numIterations": 2}}
+                ],
+            })
+            ctx = MeshContext.create()
+            run_train(engine, ep, "f", storage=storage, ctx=ctx)
+            qs = QueryServer(
+                engine, storage=storage, ctx=ctx,
+                plugins=load_plugins([]),  # no --plugin flags
+            )
+            port = qs.start("127.0.0.1", 0)
+            try:
+                base = f"http://127.0.0.1:{port}"
+                with urllib.request.urlopen(base + "/plugins.json") as r:
+                    plugins = json.load(r)
+                assert "tag-blocker" in json.dumps(plugins)
+                req = urllib.request.Request(
+                    base + "/queries.json",
+                    data=json.dumps({"user": "u1", "num": 2}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req) as r:
+                    res = json.load(r)
+                assert res.get("tagged") is True  # the blocker ran
+            finally:
+                qs.stop()
+        finally:
+            store_mod.set_storage(None)

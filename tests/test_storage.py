@@ -389,3 +389,41 @@ class TestShardedScan:
         for a in range(count):
             for b in range(a + 1, count):
                 assert not (seen_users[a] & seen_users[b])
+
+
+class TestSequences:
+    """Named monotonic counters (parity: ESSequences.scala role)."""
+
+    def test_monotone_and_independent(self, store):
+        if store.repository_bindings()["METADATA"][1] not in (
+            "memory", "sqlite", "network"
+        ):
+            pytest.skip("driver pairs METADATA with memory (covered there)")
+        seq = store.get_meta_data_sequences()
+        assert [seq.gen_next("a") for _ in range(3)] == [1, 2, 3]
+        assert seq.gen_next("b") == 1  # names are independent counters
+        assert seq.gen_next("a") == 4
+
+    def test_concurrent_callers_never_collide(self, store):
+        if store.repository_bindings()["METADATA"][1] not in (
+            "memory", "sqlite", "network"
+        ):
+            pytest.skip("driver pairs METADATA with memory (covered there)")
+        import threading
+
+        seq = store.get_meta_data_sequences()
+        got: list[int] = []
+        lock = threading.Lock()
+
+        def worker():
+            for _ in range(25):
+                v = seq.gen_next("shared")
+                with lock:
+                    got.append(v)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(got) == list(range(1, 101))  # unique + gapless
