@@ -362,12 +362,35 @@ def _param_shardings(ctx: MeshContext, params: dict, cfg: SASRecConfig):
 
 def train_sasrec(
     ctx: MeshContext,
-    interactions: Interactions,
+    interactions,
     config: Optional[SASRecConfig] = None,
 ) -> SASRecModel:
+    """``interactions`` is a full :class:`Interactions` or a
+    :class:`~predictionio_tpu.parallel.ingest.ShardedInteractions` — under
+    a multi-host launch each host holds only ITS users' complete event
+    histories (1/N ingest, entity-keyed), builds only their sequences, and
+    contributes its slice of every global batch (pure data parallelism:
+    XLA all-reduces the gradients)."""
+    from predictionio_tpu.parallel.ingest import ShardedInteractions
+
     cfg = config or SASRecConfig()
-    n_items = interactions.n_items
-    seqs = build_sequences(interactions, cfg.max_len + 1)  # +1: input/target shift
+    sharded = isinstance(interactions, ShardedInteractions)
+    if sharded:
+        if cfg.seq_parallel or cfg.n_experts:
+            raise ValueError(
+                "sharded multi-host SASRec training is pure data "
+                "parallelism; seq_parallel / n_experts claim the `model` "
+                "axis across hosts and are not supported under pio launch"
+            )
+        rows = interactions.user_rows
+        n_hosts = interactions.num_processes
+    else:
+        rows = interactions
+        n_hosts = 1
+    n_items = rows.n_items
+    # with sharded rows, non-local users simply have no events: their
+    # all-PAD sequences fall to the >=2-events filter below
+    seqs = build_sequences(rows, cfg.max_len + 1)  # +1: input/target shift
     # keep users with at least 2 events (one transition)
     keep = (seqs != PAD).sum(1) >= 2
     seqs = seqs[keep]
@@ -376,9 +399,21 @@ def train_sasrec(
         raise ValueError(
             "no user has >= 2 interaction events; sequential training needs "
             "at least one (previous item -> next item) transition"
+            + (f" (host {interactions.process_index})" if sharded else "")
         )
     n_shards = ctx.axis_size(DATA_AXIS)
-    batch = min(cfg.batch_size, pad_to_multiple(n, n_shards))
+    if sharded and n_shards % n_hosts:
+        raise ValueError(
+            f"{n_shards} device shards not divisible by {n_hosts} hosts"
+        )
+    # the batch shape must be identical on every host: derive it from the
+    # GLOBAL trainable-user count (the exchanged degree vector), never from
+    # this host's local n — unbalanced shards would otherwise assemble
+    # mismatched "global" arrays
+    n_global = (
+        int((interactions.user_counts >= 2).sum()) if sharded else n
+    )
+    batch = min(cfg.batch_size, pad_to_multiple(n_global, n_shards))
     batch = pad_to_multiple(batch, n_shards)
 
     sp_ways = ctx.axis_size(MODEL_AXIS) if cfg.seq_parallel else 1
@@ -436,9 +471,20 @@ def train_sasrec(
             updates, opt_state = opt.update(grads, opt_state)
             return optax.apply_updates(params, updates), opt_state, loss
 
-        def run_step(params, opt_state, sb):
-            seq = jax.device_put(jnp.asarray(sb), batch_sharding)
-            return step(params, opt_state, seq, cfg)
+        if sharded and n_hosts > 1:
+
+            def run_step(params, opt_state, sb):
+                # sb is THIS host's (batch/n_hosts, L) slice; the global
+                # batch assembles from process-local shards
+                seq = jax.make_array_from_process_local_data(
+                    batch_sharding, np.asarray(sb)
+                )
+                return step(params, opt_state, seq, cfg)
+        else:
+
+            def run_step(params, opt_state, sb):
+                seq = jax.device_put(jnp.asarray(sb), batch_sharding)
+                return step(params, opt_state, seq, cfg)
 
     # mid-training checkpoint/resume (orbax; same contract as ALS):
     # fingerprint ties checkpoints to this config + dataset, a mismatch
@@ -459,14 +505,24 @@ def train_sasrec(
         manager = CheckpointManager(cfg.checkpoint_dir)
         fingerprint = np.array(
             [
-                n_items, n, batch, cfg.d_model, cfg.n_layers, cfg.n_heads,
+                # n_global, not the host-local n: every host must compute
+                # the SAME fingerprint or multi-host resume diverges
+                n_items, n_global, batch, cfg.d_model, cfg.n_layers, cfg.n_heads,
                 cfg.max_len, float(cfg.lr), cfg.seed, cfg.n_experts,
                 float(cfg.expert_capacity), float(cfg.moe_aux_weight),
-                # order-sensitive: a reordered/swapped history set must
-                # NOT resume from a foreign checkpoint
-                dataset_digest(seqs),
+                # order-sensitive: a reordered/swapped history set must NOT
+                # resume from a foreign checkpoint. Sharded mode uses the
+                # exchanged host-independent row digest (every host must
+                # compute the same fingerprint) and a distinct trailing tag
+                # so cross-mode resume is rejected by shape.
+                (
+                    float(interactions.dataset_digest)
+                    if sharded
+                    else dataset_digest(seqs)
+                ),
                 int(cfg.seq_parallel),
-            ],
+            ]
+            + ([n_hosts] if sharded else []),
             dtype=np.float64,
         )
         start_epoch, restored = resume_from(manager, fingerprint, cfg.epochs)
@@ -495,13 +551,18 @@ def train_sasrec(
                 ],
             )
 
-    rng = np.random.default_rng(cfg.seed)
+    # sharded: each host samples ITS users for its slice of the global
+    # batch, with a decorrelated per-host stream (pid 0 ≡ the single-host
+    # stream, so n_hosts=1 reproduces exactly)
+    pid = interactions.process_index if sharded else 0
+    local_batch = batch // n_hosts
+    rng = np.random.default_rng(cfg.seed + 1_000_003 * pid)
     for _ in range(start_epoch):  # resume: fast-forward the batch sampler
-        rng.integers(0, n, batch)
+        rng.integers(0, n, local_batch)
 
     loss = None
     for epoch in range(start_epoch, cfg.epochs):
-        picks = rng.integers(0, n, batch)
+        picks = rng.integers(0, n, local_batch)
         params, opt_state, loss = run_step(params, opt_state, seqs[picks])
         if manager is not None and save_due(
             epoch + 1, cfg.checkpoint_interval, cfg.epochs
@@ -517,6 +578,14 @@ def train_sasrec(
                 }
             )
             manager.save(epoch + 1, state)
+    host_params = ctx.to_host(params)
+    if sharded and interactions.cleanup is not None:
+        from predictionio_tpu.parallel import distributed
+
+        if distributed.should_write_storage():
+            # to_host above is a collective: every host has long finished
+            # its exchange, so the rendezvous blobs can go
+            interactions.cleanup()
     return SASRecModel(
-        params=ctx.to_host(params), item_map=interactions.item_map, config=cfg
+        params=host_params, item_map=interactions.item_map, config=cfg
     )

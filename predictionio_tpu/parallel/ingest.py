@@ -195,6 +195,7 @@ def read_sharded_interactions(
     num_processes: Optional[int] = None,
     channel_id: Optional[int] = None,
     parts: Optional[list] = None,
+    item_pass: bool = True,
     **find_kwargs,
 ) -> ShardedInteractions:
     """The 1/N-per-host training read (two entity-keyed passes + exchange).
@@ -203,7 +204,11 @@ def read_sharded_interactions(
     (entity_type, event_names, target_entity_type, rating_key, ...).
     ``parts`` instead passes SEVERAL filter dicts whose results merge
     row-wise before the exchange — the rate+buy multi-read the templates
-    perform, still at 1/N rows per pass.
+    perform, still at 1/N rows per pass. ``item_pass=False`` skips the
+    target-keyed scan for consumers that only need per-user rows (the
+    sequence models): the global item table derives exactly from the
+    user pass (every row appears in exactly one host's user pass), so
+    ingest halves to one 1/N scan per host and ``item_rows`` is empty.
     """
     from predictionio_tpu.data.batch import merge_interactions
 
@@ -238,35 +243,53 @@ def read_sharded_interactions(
         return reads[0] if len(reads) == 1 else merge_interactions(reads)
 
     upass = read_pass("entity")
-    ipass = read_pass("target")
+    ipass = read_pass("target") if item_pass else None
     # the user pass holds ALL rows of my users (counts complete); same for
-    # the item pass by items — so the merged tables are exact global degrees
+    # the item pass by items — so the merged tables are exact global
+    # degrees. Without an item pass, per-host item histograms from the
+    # user pass merge to the same exact global table (disjoint row cover).
     user_map, user_counts, _ = exchange_entity_tables(
         storage, key + "_user", _count_table(upass.user, upass.user_map),
         pid, n,
     )
     item_map, item_counts, _ = exchange_entity_tables(
-        storage, key + "_item", _count_table(ipass.item, ipass.item_map),
+        storage, key + "_item",
+        _count_table(
+            (ipass if item_pass else upass).item,
+            (ipass if item_pass else upass).item_map,
+        ),
         pid, n,
     )
+    n_ipass = len(ipass.rating) if item_pass else 0
     logger.info(
         "sharded ingest p%d/%d: %d user-pass + %d item-pass rows of "
         "%d global ratings (%.1f%%)",
-        pid, n, len(upass.rating), len(ipass.rating), int(user_counts.sum()),
-        100.0 * (len(upass.rating) + len(ipass.rating))
+        pid, n, len(upass.rating), n_ipass, int(user_counts.sum()),
+        100.0 * (len(upass.rating) + n_ipass)
         / max(1, 2 * int(user_counts.sum())),
     )
     user_rows = _translate(upass, user_map, item_map)
-    item_rows = _translate(ipass, user_map, item_map)
+    item_rows = (
+        _translate(ipass, user_map, item_map)
+        if item_pass
+        else Interactions(
+            user=np.empty(0, np.int32), item=np.empty(0, np.int32),
+            rating=np.empty(0, np.float32), t=np.empty(0, np.float64),
+            user_map=user_map, item_map=item_map,
+        )
+    )
     # host-independent row digest for checkpoint fingerprints: one
-    # vectorized sha1 over THIS host's translated triples (global ids are
+    # vectorized sha1 over THIS host's translated rows (global ids are
     # layout-stable and the DAO scan order is deterministic), summed
-    # across hosts through a digest exchange. Sensitive to pairings and
-    # rating values — equal degree histograms must not collide.
+    # across hosts through a digest exchange. Sensitive to pairings,
+    # rating values AND event times (sequence models order by t) — equal
+    # degree histograms must not collide.
     from predictionio_tpu.core.checkpoint import dataset_digest
 
     local_digest = (
-        dataset_digest(user_rows.user, user_rows.item, user_rows.rating)
+        dataset_digest(
+            user_rows.user, user_rows.item, user_rows.rating, user_rows.t
+        )
         if len(user_rows.rating)
         else 0
     )
