@@ -512,6 +512,7 @@ class EvaluationInstances(abc.ABC):
     STATUS_INIT = "INIT"
     STATUS_EVALUATING = "EVALUATING"
     STATUS_COMPLETED = "EVALCOMPLETED"
+    STATUS_ABORTED = "ABORTED"
 
     @abc.abstractmethod
     def insert(self, instance: EvaluationInstance) -> str: ...

@@ -486,3 +486,35 @@ print("seeded", len(evs))
     }
     assert set(scans) == {0, 1} and all(0 < v < 240 for v in scans.values())
     assert_one_completed(tmp_path, env)
+
+
+@pytest.mark.slow
+def test_two_process_eval_one_instance(tmp_path):
+    """`pio launch -- eval`: every process evaluates, only the coordinator
+    records the EvaluationInstance — N hosts must not write N instances
+    (the run_train single-writer contract applied to eval)."""
+    env = sqlite_env(tmp_path)
+    env["PYTHONPATH"] = (
+        os.path.join(REPO, "tests") + os.pathsep + env["PYTHONPATH"]
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "predictionio_tpu.tools.cli", "launch",
+            "-n", "2", "--coordinator-port", str(free_port()),
+            "--", "eval", "test_evaluation.SampleEvaluation",
+        ],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    out = run_py(
+        tmp_path, env, """
+from predictionio_tpu.data.storage.registry import Storage
+st = Storage.instance()
+ev = st.get_meta_data_evaluation_instances()
+done = [i for i in ev.get_all() if i.status == ev.STATUS_COMPLETED]
+assert len(ev.get_all()) == len(done) == 1, ev.get_all()
+print("OK one evaluation instance", done[0].id)
+""",
+    )
+    assert "OK one evaluation instance" in out
