@@ -113,6 +113,7 @@ def cross_occurrence_matrix(
     n_items_primary: int,
     n_items_secondary: int,
     n_users_pad: Optional[int] = None,
+    host_reduce=None,
 ) -> jnp.ndarray:
     """Dense (primary_items, secondary_items) CROSS-occurrence counts.
 
@@ -121,6 +122,11 @@ def cross_occurrence_matrix(
     binarized incidence over a shared user axis).  Either side may be passed
     pre-blocked (:func:`block_incidence`) to amortize host work across calls;
     if so, ``n_users_pad`` used for blocking must match.
+
+    Multi-host: user axes are disjoint across hosts (entity-keyed sharded
+    ingest), so ``C_global = Σ_hosts C_local`` — pass ``host_reduce`` (e.g.
+    ``parallel.distributed.host_sum``) and each host feeds only ITS users'
+    rows; the accumulation scan stays host-local, one reduce at the end.
     """
     if n_users_pad is None:
         n_users = max(
@@ -159,6 +165,8 @@ def cross_occurrence_matrix(
         jnp.asarray(secondary.item),
         jnp.asarray(secondary.mask),
     )
+    if host_reduce is not None:
+        C = jnp.asarray(host_reduce(np.asarray(C)))
     return C[:n_items_primary, :n_items_secondary]
 
 
@@ -220,6 +228,9 @@ def cross_occurrence_topn(
     primary_counts: Optional[np.ndarray] = None,
     col_block: int = 4096,
     exclude_diagonal: bool = False,
+    secondary_counts: Optional[np.ndarray] = None,
+    host_reduce=None,
+    llr_total: Optional[float] = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k correlated PRIMARY items per INDICATOR item, never holding C.
 
@@ -232,7 +243,20 @@ def cross_occurrence_topn(
     Returns (top_items (s_items, k) int32, top_scores (s_items, k) f32) —
     rows indexed by INDICATOR item, matching ``llr.T`` + ``top_k`` on the
     dense path.
+
+    Multi-host (``host_reduce``): the per-block accumulation runs over this
+    host's users only; ``C_blk`` reduces across hosts before scoring/top-k
+    (user axes are disjoint under entity-keyed sharded ingest, so the sum
+    is exact). Callers must pass GLOBAL marginals (``primary_counts``,
+    ``secondary_counts``, and the LLR total via ``llr_total``) and a
+    data-only mesh — column blocks can't also ride a `model` axis that
+    spans hosts.
     """
+    if host_reduce is not None and ctx.axis_size(MODEL_AXIS) > 1:
+        raise ValueError(
+            "multi-host cross_occurrence_topn needs a data-only mesh: "
+            "column blocks cannot ride a `model` axis across hosts"
+        )
     n_users_pad = pad_to_multiple(n_users, _USER_BLOCK)
     if isinstance(primary, Interactions):
         primary = block_incidence(primary, n_users_pad)
@@ -242,7 +266,11 @@ def cross_occurrence_topn(
     pc_primary = jnp.asarray(
         np.pad(primary_counts.astype(np.float32), (0, p_pad - n_items_primary))
     )
-    sec_counts_full = distinct_item_counts(secondary, n_items_secondary)
+    sec_counts_full = (
+        secondary_counts.astype(np.float32)
+        if secondary_counts is not None
+        else distinct_item_counts(secondary, n_items_secondary)
+    )
 
     k = min(k, n_items_primary)
     out_items = np.zeros((n_items_secondary, k), np.int32)
@@ -251,11 +279,10 @@ def cross_occurrence_topn(
     s_user = secondary.user.astype(np.int64)
     s_item = secondary.item.astype(np.int64)
     width_pad = pad_to_multiple(min(col_block, n_items_secondary), 128)
-    total = float(n_users)
+    total = float(llr_total if llr_total is not None else n_users)
 
-    def block_kernel(pu, pi, pm, su, si, sm, p_counts, s_counts, col_start,
-                     varying=False):
-        """One column block: accumulate C over user blocks, score, top-k."""
+    def accumulate_block(pu, pi, pm, su, si, sm, varying=False):
+        """One column block's C, summed over (this host's) user blocks."""
 
         def body(C, xs):
             bpu, bpi, bpm, bsu, bsi, bsm = xs
@@ -269,6 +296,10 @@ def cross_occurrence_topn(
         if varying:  # under shard_map the carry differs per model-axis peer
             C0 = jax.lax.pcast(C0, MODEL_AXIS, to="varying")
         C, _ = jax.lax.scan(body, C0, (pu, pi, pm, su, si, sm))
+        return C
+
+    def score_block(C, p_counts, s_counts, col_start):
+        """Score + per-column top-k of one (globally complete) block."""
         if use_llr:
             scores = llr_cross_scores(C, p_counts, s_counts, total)
         else:
@@ -285,6 +316,12 @@ def cross_occurrence_topn(
             scores = jnp.where(diag, -1.0, scores)
         vals, idx = jax.lax.top_k(scores.T, k)  # per indicator column
         return vals, idx
+
+    def block_kernel(pu, pi, pm, su, si, sm, p_counts, s_counts, col_start,
+                     varying=False):
+        """Fused accumulate+score (the single-host fast path)."""
+        C = accumulate_block(pu, pi, pm, su, si, sm, varying=varying)
+        return score_block(C, p_counts, s_counts, col_start)
 
     # sort secondary ONCE by item so each column block is a contiguous slice
     s_order = np.argsort(s_item, kind="stable")
@@ -370,6 +407,25 @@ def cross_occurrence_topn(
             for j, (_, _, start, width) in enumerate(group[:real_n]):
                 out_scores[start : start + width] = vals[j, :width]
                 out_items[start : start + width] = idx[j, :width]
+    elif host_reduce is not None:
+        # multi-host: accumulate locally, reduce the block across hosts,
+        # THEN score/top-k — top-k does not commute with the host sum
+        run_acc = jax.jit(accumulate_block)
+        run_score = jax.jit(score_block)
+        for bi in range(len(starts)):
+            blocked_s, s_counts, start, width = build_block(bi)
+            C_local = run_acc(
+                pu, pi, pm,
+                jnp.asarray(blocked_s.local_user),
+                jnp.asarray(blocked_s.item),
+                jnp.asarray(blocked_s.mask),
+            )
+            C = jnp.asarray(host_reduce(np.asarray(C_local)))
+            vals, idx = run_score(
+                C, pc_primary, jnp.asarray(s_counts), jnp.asarray(start)
+            )
+            out_scores[start : start + width] = np.asarray(vals)[:width]
+            out_items[start : start + width] = np.asarray(idx)[:width]
     else:
         run_block = jax.jit(block_kernel)
         for bi in range(len(starts)):

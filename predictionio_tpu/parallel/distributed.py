@@ -93,6 +93,39 @@ def num_processes() -> int:
     return jax.process_count()
 
 
+# rows gathered per reduction slab: bounds the transient (n_hosts, slab)
+# stack so reducing a ~1 GB Gram block on N hosts never holds N copies
+_HOST_SUM_SLAB_ELEMS = 16_777_216
+
+
+def host_sum(x):
+    """Sum identically-shaped per-host arrays across processes.
+
+    The cross-host reduction for host-side partial results (e.g. the CCO
+    per-host Gram blocks, whose user axes are disjoint under entity-keyed
+    sharded ingest). Large arrays reduce in row slabs so peak memory is
+    one extra slab per peer, not a full extra copy per peer.
+    Single-process: identity.
+    """
+    import numpy as np
+
+    x = np.asarray(x)
+    if num_processes() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    if x.ndim < 2 or x.size <= _HOST_SUM_SLAB_ELEMS:
+        return np.asarray(multihost_utils.process_allgather(x)).sum(axis=0)
+    rows_per_slab = max(1, _HOST_SUM_SLAB_ELEMS // max(1, x[0].size))
+    out = np.empty_like(x)
+    for s in range(0, x.shape[0], rows_per_slab):
+        piece = np.ascontiguousarray(x[s : s + rows_per_slab])
+        out[s : s + rows_per_slab] = np.asarray(
+            multihost_utils.process_allgather(piece)
+        ).sum(axis=0)
+    return out
+
+
 def run_id() -> Optional[str]:
     """The launch-scoped unique id (set by ``pio launch`` on every worker).
 

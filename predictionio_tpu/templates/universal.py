@@ -77,10 +77,17 @@ class TrainingData(SanityCheck):
     user_map: BiMap
     item_map: BiMap
     primary_event: str
+    # multi-host sharded ingest: per_event holds only THIS host's users'
+    # rows (global ids); n_hosts > 1 switches the trainer to per-host
+    # accumulation + cross-host reduction
+    n_hosts: int = 1
+    global_primary_rows: int = 0  # Σ hosts (sanity must see the whole set)
+    cleanup: Optional[object] = None  # removes the rendezvous blobs
 
     def sanity_check(self):
         primary = self.per_event.get(self.primary_event)
-        if primary is None or len(primary) == 0:
+        local = 0 if primary is None else len(primary)
+        if max(local, self.global_primary_rows) == 0:
             raise ValueError(
                 f"no {self.primary_event!r} (primary) events found; check appName"
             )
@@ -96,6 +103,10 @@ class URDataSource(DataSource):
     params_cls = URDataSourceParams
 
     def read_training(self, ctx) -> TrainingData:
+        from predictionio_tpu.parallel import distributed
+
+        if distributed.is_initialized() and distributed.num_processes() > 1:
+            return self._read_training_sharded()
         # one store scan for ALL event types, split per name afterwards
         batch = PEventStore.find(
             self.params.appName,
@@ -119,6 +130,72 @@ class URDataSource(DataSource):
             user_map=user_map,
             item_map=item_map,
             primary_event=self.params.eventNames[0],
+        )
+
+    def _read_training_sharded(self) -> TrainingData:
+        """Multi-host: ONE entity-keyed 1/N scan covers all event types
+        (this host's users' complete histories); global id spaces come
+        from the model-repo table exchange (parallel/ingest.py)."""
+        from collections import Counter
+
+        from predictionio_tpu.data.store import get_storage, resolve_app
+        from predictionio_tpu.parallel import distributed
+        from predictionio_tpu.parallel.ingest import exchange_entity_tables
+
+        run_key = distributed.run_id()
+        if run_key is None:
+            raise RuntimeError(
+                "sharded ingest needs a launch-scoped run id: launch "
+                "workers via `pio launch` (exports PIO_RUN_ID)"
+            )
+        pid, n = distributed.process_index(), distributed.num_processes()
+        storage = get_storage()
+        app_id, channel_id = resolve_app(self.params.appName)
+        batch = storage.get_p_events().find(
+            app_id,
+            channel_id=channel_id,
+            entity_type="user",
+            event_names=list(self.params.eventNames),
+            target_entity_type="item",
+            shard=(pid, n),
+            shard_key="entity",
+        )
+        user_map, _, _ = exchange_entity_tables(
+            storage, f"{run_key}_ur_user", dict(Counter(batch.entity_id)),
+            pid, n,
+        )
+        item_map, _, _ = exchange_entity_tables(
+            storage, f"{run_key}_ur_item",
+            dict(Counter(
+                t for t in batch.target_entity_id if t is not None
+            )),
+            pid, n,
+        )
+        per_event = {
+            name: batch.filter_events([name]).interactions(
+                user_map=user_map, item_map=item_map
+            )
+            for name in self.params.eventNames
+        }
+        primary = per_event[self.params.eventNames[0]]
+        global_primary = int(
+            distributed.host_sum(np.array([len(primary)]))[0]
+        )
+
+        def cleanup():
+            from predictionio_tpu.parallel.ingest import cleanup_exchange
+
+            for suffix in ("_ur_user", "_ur_item"):
+                cleanup_exchange(storage, run_key + suffix, n)
+
+        return TrainingData(
+            per_event=per_event,
+            user_map=user_map,
+            item_map=item_map,
+            primary_event=self.params.eventNames[0],
+            n_hosts=n,
+            global_primary_rows=global_primary,
+            cleanup=cleanup,
         )
 
 
@@ -145,36 +222,82 @@ class URAlgorithm(Algorithm):
     DENSE_ITEM_LIMIT = DENSE_ITEM_LIMIT
 
     def train(self, ctx, pd: TrainingData) -> URModel:
+        from predictionio_tpu.parallel import distributed
+
+        sharded = pd.n_hosts > 1
         primary = pd.per_event[pd.primary_event]
         n_items = len(pd.item_map)
-        n_users = len(pd.user_map)
-        n_users_pad = pad_to_multiple(n_users, _USER_BLOCK)
+        n_users = len(pd.user_map)  # GLOBAL observed users (LLR total)
+        per_event = pd.per_event
+        if sharded:
+            # the user axes across hosts are disjoint (entity-keyed 1/N
+            # ingest), so each host COMPACTS its users to a dense local
+            # range — C is a sum over users, so ids are immaterial; the
+            # compaction keeps per-host scan work at 1/N of the blocks.
+            # The one shared constraint: every event type must use the
+            # SAME local user axis (C = A_pᵀ A_s joins on it).
+            local_users = np.unique(np.concatenate(
+                [i.user for i in per_event.values() if len(i)] or
+                [np.empty(0, np.int32)]
+            ))
+            lut = np.zeros(max(n_users, 1), np.int64)
+            lut[local_users] = np.arange(len(local_users))
+            per_event = {
+                name: dataclasses.replace(
+                    inter, user=lut[inter.user.astype(np.int64)].astype(np.int32)
+                )
+                for name, inter in per_event.items()
+            }
+            primary = per_event[pd.primary_event]
+            n_axis_users = max(len(local_users), 1)
+            host_reduce = distributed.host_sum
+        else:
+            n_axis_users = n_users
+            host_reduce = None
+        n_users_pad = pad_to_multiple(n_axis_users, _USER_BLOCK)
         # block the primary side ONCE; reused for every indicator matmul
         primary_blocked = block_incidence(primary, n_users_pad)
-        # LLR marginals = DISTINCT-user counts, matching binarized incidence
+        # LLR marginals = DISTINCT-user counts, matching binarized
+        # incidence; under sharding the local histograms sum exactly
+        # (disjoint users) to the global marginals
         primary_counts_np = distinct_item_counts(primary, n_items)
+        if sharded:
+            primary_counts_np = host_reduce(primary_counts_np)
         primary_counts = jnp.asarray(primary_counts_np)
         k = min(self.params.maxCorrelatorsPerItem, n_items)
         blocked_mode = n_items > self.DENSE_ITEM_LIMIT
         indicators = {}
-        for name, inter in pd.per_event.items():
-            if len(inter) == 0:
+        for name, inter in per_event.items():
+            # ONE reduced vector answers both "any events globally?" and
+            # the LLR marginals; the primary's is reused from above (extra
+            # collectives per event would serialize real multi-host runs)
+            if sharded and name == pd.primary_event:
+                counts_t_np = primary_counts_np
+            else:
+                counts_t_np = distinct_item_counts(inter, n_items)
+                if sharded:
+                    counts_t_np = host_reduce(counts_t_np)
+            if counts_t_np.sum() == 0:
                 logger.warning("indicator %s has no events; skipped", name)
                 continue
             if blocked_mode:
                 idx, vals = cross_occurrence_topn(
                     ctx, primary_blocked, inter, n_items, n_items,
-                    n_users=n_users, k=k, use_llr=True,
+                    n_users=n_axis_users, k=k, use_llr=True,
                     primary_counts=primary_counts_np,
                     exclude_diagonal=(name == pd.primary_event),
+                    secondary_counts=counts_t_np,
+                    host_reduce=host_reduce,
+                    llr_total=float(n_users),
                 )
                 indicators[name] = (idx, vals)
                 continue
             C = cross_occurrence_matrix(
                 ctx, primary_blocked, inter, n_items, n_items,
                 n_users_pad=n_users_pad,
+                host_reduce=host_reduce,
             )
-            counts_t = jnp.asarray(distinct_item_counts(inter, n_items))
+            counts_t = jnp.asarray(counts_t_np)
             llr = llr_cross_scores(C, primary_counts, counts_t, n_users)
             if name == pd.primary_event:
                 llr = llr - jnp.diag(jnp.diag(llr))  # self-pairs excluded
@@ -183,6 +306,9 @@ class URAlgorithm(Algorithm):
                 np.asarray(idx, np.int32),
                 np.asarray(vals, np.float32),
             )
+        if sharded and pd.cleanup is not None:
+            if distributed.should_write_storage():
+                pd.cleanup()
         return URModel(
             indicators=indicators,
             item_map=pd.item_map,

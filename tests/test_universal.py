@@ -246,3 +246,78 @@ class TestURTemplate:
         )
         with pytest.raises(ValueError, match="primary"):
             engine.train(ctx, ep)
+
+
+class TestShardedBlockedTopN:
+    """The multi-host blocked top-n path (host_reduce branch), exercised
+    in-process: two user-disjoint "hosts" run the per-block accumulation
+    and a capture-then-replay fake reduce sums their blocks — the result
+    must equal the single-host blocked top-n over all rows."""
+
+    def test_two_fake_hosts_match_full(self, ctx):
+        from predictionio_tpu.data.batch import Interactions
+        from predictionio_tpu.models.cooccurrence import (
+            block_incidence,
+            cross_occurrence_topn,
+            distinct_item_counts,
+            incidence_width,
+        )
+        from predictionio_tpu.parallel.mesh import pad_to_multiple
+
+        rng = np.random.default_rng(3)
+        n_users, n_items, n_rows = 64, 40, 900
+
+        def make(u, i):
+            return Interactions(
+                user=u.astype(np.int32), item=i.astype(np.int32),
+                rating=np.ones(len(u), np.float32), t=np.zeros(len(u)),
+                user_map=None, item_map=None,
+            )
+
+        users = rng.integers(0, n_users, n_rows)
+        items = rng.integers(0, n_items, n_rows)
+        full = make(users, items)
+        pc = distinct_item_counts(full, n_items)
+        k = 7
+        # ground truth: single-host blocked path, small col_block to force
+        # several column blocks
+        want_idx, want_vals = cross_occurrence_topn(
+            ctx, full, full, n_items, n_items, n_users=n_users, k=k,
+            primary_counts=pc, col_block=16, exclude_diagonal=True,
+        )
+
+        # split by user parity (disjoint user axes), compact each side
+        def side(parity):
+            sel = (users % 2) == parity
+            u = users[sel]
+            uniq, inv = np.unique(u, return_inverse=True)
+            return make(inv, items[sel]), len(uniq)
+
+        (a, n_a), (b, n_b) = side(0), side(1)
+
+        # pass 1: "host B" runs with a capturing reduce (results discarded)
+        captured = []
+        cross_occurrence_topn(
+            ctx, b, b, n_items, n_items, n_users=n_b, k=k,
+            primary_counts=pc, col_block=16, exclude_diagonal=True,
+            secondary_counts=distinct_item_counts(full, n_items),
+            host_reduce=lambda C: captured.append(C.copy()) or C,
+            llr_total=float(n_users),
+        )
+        # pass 2: "host A" replays B's blocks into its reduce
+        replay = list(captured)
+        got_idx, got_vals = cross_occurrence_topn(
+            ctx, a, a, n_items, n_items, n_users=n_a, k=k,
+            primary_counts=pc, col_block=16, exclude_diagonal=True,
+            secondary_counts=distinct_item_counts(full, n_items),
+            host_reduce=lambda C: C + replay.pop(0),
+            llr_total=float(n_users),
+        )
+        assert not replay  # same number of blocks on both "hosts"
+        np.testing.assert_allclose(got_vals, want_vals, rtol=1e-4, atol=1e-4)
+        # indices must agree wherever the score is not tied with the next
+        # rank (ties may legitimately order differently across paths)
+        untied = np.ones_like(want_idx, bool)
+        untied[:, :-1] = ~np.isclose(want_vals[:, :-1], want_vals[:, 1:])
+        untied[:, 1:] &= ~np.isclose(want_vals[:, 1:], want_vals[:, :-1])
+        assert (got_idx[untied] == want_idx[untied]).all()
