@@ -460,10 +460,18 @@ DENSE_ITEM_LIMIT = 16_384
 
 def train_cooccurrence(
     ctx: MeshContext,
-    interactions: Interactions,
+    interactions,
     n: int = 20,
     use_llr: bool = False,
 ) -> CooccurrenceModel:
+    """``interactions`` is a full :class:`Interactions` or a
+    :class:`~predictionio_tpu.parallel.ingest.ShardedInteractions` (each
+    host holds its users' rows; per-host Grams reduce exactly across
+    hosts — disjoint user axes)."""
+    from predictionio_tpu.parallel.ingest import ShardedInteractions
+
+    if isinstance(interactions, ShardedInteractions):
+        return _train_cooccurrence_sharded(ctx, interactions, n, use_llr)
     n_items_total = interactions.n_items
     if n_items_total > DENSE_ITEM_LIMIT:
         # self-case C is symmetric: per-column top-k == per-row top-k
@@ -499,3 +507,61 @@ def train_cooccurrence(
         top_scores=np.asarray(vals, np.float32),
         item_map=interactions.item_map,
     )
+
+
+def _train_cooccurrence_sharded(
+    ctx: MeshContext, sh, n: int, use_llr: bool
+) -> CooccurrenceModel:
+    """Multi-host self-co-occurrence: compact this host's users, accumulate
+    local Gram blocks, reduce across hosts, then score/top-k."""
+    from predictionio_tpu.parallel import distributed
+
+    inter = sh.user_rows
+    n_items_total = sh.n_items
+    if len(inter.user):
+        uniq, inv = np.unique(inter.user, return_inverse=True)
+    else:
+        uniq = inv = np.empty(0, np.int64)
+    local = Interactions(
+        user=inv.astype(np.int32),
+        item=inter.item,
+        rating=inter.rating,
+        t=inter.t,
+        user_map=None,
+        item_map=sh.item_map,
+    )
+    n_local_users = max(len(uniq), 1)
+    # disjoint users ⇒ local distinct-count histograms sum exactly
+    pc = distributed.host_sum(distinct_item_counts(local, n_items_total))
+    k = min(n, n_items_total)
+    if n_items_total > DENSE_ITEM_LIMIT:
+        idx, vals = cross_occurrence_topn(
+            ctx, local, local, n_items_total, n_items_total,
+            n_users=n_local_users, k=k, use_llr=use_llr,
+            primary_counts=pc, exclude_diagonal=True,
+            secondary_counts=pc, host_reduce=distributed.host_sum,
+            llr_total=float(sh.n_users),
+        )
+        model = CooccurrenceModel(
+            top_items=idx, top_scores=vals, item_map=sh.item_map
+        )
+    else:
+        # explicit n_users_pad: an EMPTY host shard (few users, many
+        # hosts) must still run the same collectives — deriving the pad
+        # from the empty local rows would crash it and hang the peers
+        C = cross_occurrence_matrix(
+            ctx, local, local, n_items_total, n_items_total,
+            n_users_pad=pad_to_multiple(n_local_users, _USER_BLOCK),
+            host_reduce=distributed.host_sum,
+        )
+        scores = llr_scores(C, n_users=sh.n_users) if use_llr else C
+        scores = scores - jnp.diag(jnp.diag(scores))  # exclude self-pairs
+        vals, idx = jax.lax.top_k(scores, k)
+        model = CooccurrenceModel(
+            top_items=np.asarray(idx, np.int32),
+            top_scores=np.asarray(vals, np.float32),
+            item_map=sh.item_map,
+        )
+    if sh.cleanup is not None and distributed.should_write_storage():
+        sh.cleanup()  # drop the rendezvous blobs (idempotent)
+    return model

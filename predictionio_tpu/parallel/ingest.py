@@ -187,6 +187,113 @@ def _count_table(codes: np.ndarray, id_map: BiMap) -> dict:
     return {inv[i]: int(c) for i, c in enumerate(counts)}
 
 
+def template_interactions(
+    app_name: str,
+    channel_name: Optional[str] = None,
+    parts: Optional[list] = None,
+    item_pass: bool = True,
+    force_local: bool = False,
+    **find_kwargs,
+):
+    """The datasource entry point templates share: a plain
+    ``PEventStore.find_interactions`` single-host, or the 1/N sharded read
+    under an active multi-host launch. Returns ``Interactions`` or
+    ``ShardedInteractions`` accordingly; the trainers dispatch on the
+    type. ``force_local`` keeps the full read even under a launch (e.g.
+    ``read_eval``'s row-level fold split needs every row on every host).
+    """
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.data.batch import merge_interactions
+
+    if (
+        not force_local
+        and distributed.is_initialized()
+        and distributed.num_processes() > 1
+    ):
+        app_id, channel_id = store_mod.resolve_app(app_name, channel_name)
+        return read_sharded_interactions(
+            store_mod.get_storage(),
+            app_id,
+            channel_id=channel_id,
+            parts=parts,
+            item_pass=item_pass,
+            **find_kwargs,
+        )
+    if parts is not None:
+        reads = [
+            store_mod.PEventStore.find_interactions(app_name, **p)
+            for p in parts
+        ]
+        reads = [r for r in reads if len(r)] or reads[:1]
+        return reads[0] if len(reads) == 1 else merge_interactions(reads)
+    return store_mod.PEventStore.find_interactions(app_name, **find_kwargs)
+
+
+def _resolve_rendezvous(run_key, process_index, num_processes):
+    pid = (
+        process_index
+        if process_index is not None
+        else distributed.process_index()
+    )
+    n = (
+        num_processes
+        if num_processes is not None
+        else distributed.num_processes()
+    )
+    key = run_key or distributed.run_id()
+    if key is None:
+        raise RuntimeError(
+            "sharded ingest needs a launch-scoped run id: launch workers "
+            "via `pio launch` (exports PIO_RUN_ID) or pass run_key="
+        )
+    return pid, n, key
+
+
+def read_sharded_event_batch(
+    storage,
+    app_id: int,
+    run_key: Optional[str] = None,
+    process_index: Optional[int] = None,
+    num_processes: Optional[int] = None,
+    channel_id: Optional[int] = None,
+    **find_kwargs,
+):
+    """1/N entity-keyed EventBatch read + globally-merged id tables.
+
+    The multi-event variant of :func:`read_sharded_interactions` for
+    consumers that split one scan per event type themselves (the Universal
+    Recommender's shared-id-space read). Returns
+    ``(batch, user_map, item_map, cleanup)`` — the batch holds THIS host's
+    users' complete events, the maps are identical on every host, and
+    ``cleanup`` (coordinator, post-train) removes the rendezvous blobs.
+    """
+    from collections import Counter
+
+    pid, n, key = _resolve_rendezvous(run_key, process_index, num_processes)
+    batch = storage.get_p_events().find(
+        app_id, channel_id=channel_id, shard=(pid, n), shard_key="entity",
+        **find_kwargs,
+    )
+    user_map, _, _ = exchange_entity_tables(
+        storage, key + "_buser", dict(Counter(batch.entity_id)), pid, n
+    )
+    item_map, _, _ = exchange_entity_tables(
+        storage, key + "_bitem",
+        dict(Counter(t for t in batch.target_entity_id if t is not None)),
+        pid, n,
+    )
+
+    def cleanup():
+        for suffix in ("_buser", "_bitem"):
+            cleanup_exchange(storage, key + suffix, n)
+
+    logger.info(
+        "sharded batch ingest p%d/%d: %d rows, %d users, %d items",
+        pid, n, len(batch), len(user_map), len(item_map),
+    )
+    return batch, user_map, item_map, cleanup
+
+
 def read_sharded_interactions(
     storage,
     app_id: int,
@@ -212,22 +319,7 @@ def read_sharded_interactions(
     """
     from predictionio_tpu.data.batch import merge_interactions
 
-    pid = (
-        process_index
-        if process_index is not None
-        else distributed.process_index()
-    )
-    n = (
-        num_processes
-        if num_processes is not None
-        else distributed.num_processes()
-    )
-    key = run_key or distributed.run_id()
-    if key is None:
-        raise RuntimeError(
-            "sharded ingest needs a launch-scoped run id: launch workers "
-            "via `pio launch` (exports PIO_RUN_ID) or pass run_key="
-        )
+    pid, n, key = _resolve_rendezvous(run_key, process_index, num_processes)
     pe = storage.get_p_events()
     part_kwargs = parts if parts is not None else [find_kwargs]
 

@@ -99,7 +99,11 @@ class ECommDataSource(DataSource):
     params_cls = ECommDataSourceParams
 
     def read_training(self, ctx) -> TrainingData:
-        inter = PEventStore.find_interactions(
+        from predictionio_tpu.parallel.ingest import template_interactions
+
+        # single-host: a plain columnar read; multi-host launch: the 1/N
+        # entity-keyed sharded read (the ALS trainer dispatches on type)
+        inter = template_interactions(
             self.params.appName,
             entity_type="user",
             event_names=list(self.params.eventNames),
@@ -163,10 +167,24 @@ class ECommAlgorithm(Algorithm):
                 seed=3 if p.seed is None else p.seed,
             ),
         )
-        # trainDefault (ECommAlgorithm.scala:211): popular-count fallback
-        popular = np.bincount(
-            pd.interactions.item, minlength=len(als.item_map)
-        ).astype(np.float32)
+        # trainDefault (ECommAlgorithm.scala:211): popular-count fallback.
+        # Sharded multi-host: local item histograms sum exactly across
+        # hosts (each rating counted once, on its user's host)
+        from predictionio_tpu.parallel.ingest import ShardedInteractions
+
+        if isinstance(pd.interactions, ShardedInteractions):
+            from predictionio_tpu.parallel import distributed
+
+            popular = distributed.host_sum(
+                np.bincount(
+                    pd.interactions.user_rows.item,
+                    minlength=len(als.item_map),
+                )
+            ).astype(np.float32)
+        else:
+            popular = np.bincount(
+                pd.interactions.item, minlength=len(als.item_map)
+            ).astype(np.float32)
         return ECommModel(
             als=als, popular=popular, item_categories=pd.item_categories
         )

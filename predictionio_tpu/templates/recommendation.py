@@ -42,8 +42,7 @@ from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.core.evaluation import EngineParamsGenerator, Evaluation
 from predictionio_tpu.core.self_cleaning import SelfCleaningDataSource
 from predictionio_tpu.core.metrics import OptionAverageMetric
-from predictionio_tpu.data.batch import Interactions, merge_interactions
-from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.data.batch import Interactions
 from predictionio_tpu.models.als import ALSConfig, ALSModel, ALSScorer, train_als
 from predictionio_tpu.parallel.mesh import MeshContext
 
@@ -135,18 +134,20 @@ class RecommendationDataSource(SelfCleaningDataSource, DataSource):
             ),
         ]
 
-    def _read_interactions(self) -> Interactions:
+    def _read_interactions(self, sharded_ok: bool = True) -> Interactions:
         # one columnar read per event type (fast path on parquet), merged
-        # with shared id maps; buys weigh BUY_WEIGHT like the reference
-        parts = []
-        part = None
-        for spec in self._part_filters():
-            part = PEventStore.find_interactions(self.params.appName, **spec)
-            if len(part):
-                parts.append(part)
-        if not parts:
-            return part  # empty Interactions with empty maps
-        return merge_interactions(parts) if len(parts) > 1 else parts[0]
+        # with shared id maps; buys weigh BUY_WEIGHT like the reference.
+        # Under a multi-host launch this becomes the 1/N entity-keyed
+        # sharded read (parallel/ingest.py); the trainer dispatches on
+        # type. read_eval needs the full rows on every host (its fold
+        # split is row-level) and passes sharded_ok=False.
+        from predictionio_tpu.parallel.ingest import template_interactions
+
+        return template_interactions(
+            self.params.appName,
+            parts=self._part_filters(),
+            force_local=not sharded_ok,
+        )
 
     def read_training(self, ctx):
         from predictionio_tpu.parallel import distributed
@@ -165,25 +166,6 @@ class RecommendationDataSource(SelfCleaningDataSource, DataSource):
                 "launch without eventWindow"
             )
         self.clean_persisted_events()  # no-op without an eventWindow param
-        if multihost:
-            # multi-host launch: each host ingests 1/N of the event store
-            # with entity-keyed pushdown and the hosts exchange id tables
-            # through the model repo (SURVEY §7 "BiMap at scale";
-            # parallel/ingest.py). The trainer consumes the sharded form.
-            from predictionio_tpu.data.store import get_storage, resolve_app
-            from predictionio_tpu.parallel.ingest import (
-                read_sharded_interactions,
-            )
-
-            app_id, channel_id = resolve_app(self.params.appName)
-            return TrainingData(
-                read_sharded_interactions(
-                    get_storage(),
-                    app_id,
-                    channel_id=channel_id,
-                    parts=self._part_filters(),
-                )
-            )
         return TrainingData(self._read_interactions())
 
     def read_eval(self, ctx):
@@ -191,7 +173,7 @@ class RecommendationDataSource(SelfCleaningDataSource, DataSource):
         ep = self.params.evalParams or {}
         k_fold = int(ep.get("kFold", 3))
         query_num = int(ep.get("queryNum", 10))
-        inter = self._read_interactions()
+        inter = self._read_interactions(sharded_ok=False)
         n = len(inter)
         fold_of = np.arange(n) % k_fold
         folds = []

@@ -25,7 +25,7 @@ from predictionio_tpu.core import (
 )
 from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.data.batch import Interactions
-from predictionio_tpu.data.store import LEventStore, PEventStore
+from predictionio_tpu.data.store import LEventStore
 from predictionio_tpu.models.sequential import (
     SASRecConfig,
     SASRecModel,
@@ -71,37 +71,19 @@ class SequentialDataSource(DataSource):
     params_cls = SeqDataSourceParams
 
     def read_training(self, ctx) -> TrainingData:
-        from predictionio_tpu.parallel import distributed
+        from predictionio_tpu.parallel.ingest import template_interactions
 
-        if distributed.is_initialized() and distributed.num_processes() > 1:
-            # multi-host launch: 1/N ingest with entity-keyed pushdown —
-            # each host reads only ITS users' complete histories and the
-            # id tables merge through the model repo (parallel/ingest.py)
-            from predictionio_tpu.data.store import get_storage, resolve_app
-            from predictionio_tpu.parallel.ingest import (
-                read_sharded_interactions,
-            )
-
-            app_id, channel_id = resolve_app(self.params.appName)
-            return TrainingData(
-                interactions=read_sharded_interactions(
-                    get_storage(),
-                    app_id,
-                    channel_id=channel_id,
-                    entity_type="user",
-                    event_names=list(self.params.eventNames),
-                    target_entity_type="item",
-                    # SASRec consumes per-user rows only; the global item
-                    # table derives exactly from the user pass
-                    item_pass=False,
-                )
-            )
+        # single-host: plain columnar read; multi-host launch: 1/N
+        # entity-keyed sharded read. SASRec consumes per-user rows only,
+        # so the sharded read skips the target-keyed pass (the global item
+        # table derives exactly from the user pass).
         return TrainingData(
-            interactions=PEventStore.find_interactions(
+            interactions=template_interactions(
                 self.params.appName,
                 entity_type="user",
                 event_names=list(self.params.eventNames),
                 target_entity_type="item",
+                item_pass=False,
             )
         )
 

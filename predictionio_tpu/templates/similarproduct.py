@@ -94,7 +94,12 @@ class SimilarProductDataSource(DataSource):
     params_cls = DataSourceParams
 
     def read_training(self, ctx) -> TrainingData:
-        inter = PEventStore.find_interactions(
+        from predictionio_tpu.parallel.ingest import template_interactions
+
+        # single-host: a plain columnar read; multi-host launch: the 1/N
+        # entity-keyed sharded read (ALS and cooccurrence trainers both
+        # dispatch on the returned type)
+        inter = template_interactions(
             self.params.appName,
             entity_type="user",
             event_names=list(self.params.eventNames),

@@ -37,7 +37,6 @@ from predictionio_tpu.core import (
 )
 from predictionio_tpu.core.controller import SanityCheck
 from predictionio_tpu.data.batch import Interactions
-from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.models.als import ALSConfig, ALSModel, train_als
 
 logger = logging.getLogger(__name__)
@@ -84,7 +83,9 @@ class SimilarUserDataSource(DataSource):
     params_cls = SimilarUserDataSourceParams
 
     def read_training(self, ctx) -> TrainingData:
-        follows = PEventStore.find_interactions(
+        from predictionio_tpu.parallel.ingest import template_interactions
+
+        follows = template_interactions(
             self.params.appName,
             entity_type="user",
             event_names=list(self.params.eventNames),

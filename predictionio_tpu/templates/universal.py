@@ -136,40 +136,18 @@ class URDataSource(DataSource):
         """Multi-host: ONE entity-keyed 1/N scan covers all event types
         (this host's users' complete histories); global id spaces come
         from the model-repo table exchange (parallel/ingest.py)."""
-        from collections import Counter
-
         from predictionio_tpu.data.store import get_storage, resolve_app
         from predictionio_tpu.parallel import distributed
-        from predictionio_tpu.parallel.ingest import exchange_entity_tables
+        from predictionio_tpu.parallel.ingest import read_sharded_event_batch
 
-        run_key = distributed.run_id()
-        if run_key is None:
-            raise RuntimeError(
-                "sharded ingest needs a launch-scoped run id: launch "
-                "workers via `pio launch` (exports PIO_RUN_ID)"
-            )
-        pid, n = distributed.process_index(), distributed.num_processes()
-        storage = get_storage()
         app_id, channel_id = resolve_app(self.params.appName)
-        batch = storage.get_p_events().find(
+        batch, user_map, item_map, cleanup = read_sharded_event_batch(
+            get_storage(),
             app_id,
             channel_id=channel_id,
             entity_type="user",
             event_names=list(self.params.eventNames),
             target_entity_type="item",
-            shard=(pid, n),
-            shard_key="entity",
-        )
-        user_map, _, _ = exchange_entity_tables(
-            storage, f"{run_key}_ur_user", dict(Counter(batch.entity_id)),
-            pid, n,
-        )
-        item_map, _, _ = exchange_entity_tables(
-            storage, f"{run_key}_ur_item",
-            dict(Counter(
-                t for t in batch.target_entity_id if t is not None
-            )),
-            pid, n,
         )
         per_event = {
             name: batch.filter_events([name]).interactions(
@@ -181,19 +159,12 @@ class URDataSource(DataSource):
         global_primary = int(
             distributed.host_sum(np.array([len(primary)]))[0]
         )
-
-        def cleanup():
-            from predictionio_tpu.parallel.ingest import cleanup_exchange
-
-            for suffix in ("_ur_user", "_ur_item"):
-                cleanup_exchange(storage, run_key + suffix, n)
-
         return TrainingData(
             per_event=per_event,
             user_map=user_map,
             item_map=item_map,
             primary_event=self.params.eventNames[0],
-            n_hosts=n,
+            n_hosts=distributed.num_processes(),
             global_primary_rows=global_primary,
             cleanup=cleanup,
         )

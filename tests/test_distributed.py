@@ -630,3 +630,79 @@ print("UR SHARDED == SINGLE-HOST OK")
         timeout=300,
     )
     assert "UR SHARDED == SINGLE-HOST OK" in out
+
+
+@pytest.mark.slow
+def test_two_process_similarproduct_multi_algo_sharded(tmp_path):
+    """Multi-algorithm template under sharded ingest: one 2-process launch
+    trains ALS + cooccurrence from the same 1/N reads; the deployed model
+    must answer similar-item queries."""
+    import json as jsonlib
+
+    env = sqlite_env(tmp_path)
+    run_py(
+        tmp_path, env, """
+import numpy as np
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.data import Event
+from predictionio_tpu.data.storage.base import App
+st = Storage.instance()
+app_id = st.get_meta_data_apps().insert(App(0, "spapp"))
+le = st.get_l_events(); le.init(app_id)
+rng = np.random.default_rng(9)
+evs = [Event(event="view", entity_type="user", entity_id=f"u{u}",
+             target_entity_type="item", target_entity_id=f"i{i}")
+       for u in range(50) for i in rng.choice(20, 6, replace=False)]
+le.batch_insert(evs, app_id)
+print("seeded", len(evs))
+""",
+    )
+    (tmp_path / "engine.json").write_text(
+        jsonlib.dumps(
+            {
+                "id": "default",
+                "engineFactory": (
+                    "predictionio_tpu.templates.similarproduct."
+                    "SimilarProductEngine"
+                ),
+                "datasource": {"params": {"appName": "spapp"}},
+                "algorithms": [
+                    {"name": "als", "params": {"rank": 4, "numIterations": 3}},
+                    {"name": "cooccurrence", "params": {"n": 5}},
+                ],
+            }
+        )
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "predictionio_tpu.tools.cli", "launch",
+            "-n", "2", "--coordinator-port", str(free_port()), "--", "train",
+        ],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    assert_one_completed(tmp_path, env)
+    out = run_py(
+        tmp_path, env, """
+from predictionio_tpu.core.workflow import prepare_deploy
+from predictionio_tpu.data import store as store_mod
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.templates.similarproduct import Query, SimilarProductEngine
+
+st = Storage.instance()
+store_mod.set_storage(st)
+ctx = MeshContext.create()
+engine = SimilarProductEngine.apply()
+ei = st.get_meta_data_engine_instances()
+inst = [i for i in ei.get_all() if i.status == ei.STATUS_COMPLETED][0]
+_, algorithms, serving, models = prepare_deploy(engine, inst, storage=st, ctx=ctx)
+preds = [a.predict(m, Query(items=["i1"], num=3))
+         for a, m in zip(algorithms, models)]
+result = serving.serve(Query(items=["i1"], num=3), preds)
+assert len(result.itemScores) == 3, result
+print("OK deployed similarproduct answers", [s.item for s in result.itemScores])
+""",
+    )
+    assert "OK deployed similarproduct answers" in out

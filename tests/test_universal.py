@@ -257,12 +257,9 @@ class TestShardedBlockedTopN:
     def test_two_fake_hosts_match_full(self, ctx):
         from predictionio_tpu.data.batch import Interactions
         from predictionio_tpu.models.cooccurrence import (
-            block_incidence,
             cross_occurrence_topn,
             distinct_item_counts,
-            incidence_width,
         )
-        from predictionio_tpu.parallel.mesh import pad_to_multiple
 
         rng = np.random.default_rng(3)
         n_users, n_items, n_rows = 64, 40, 900
