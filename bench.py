@@ -327,6 +327,51 @@ def main() -> None:
     )
     print(f"INFO: utilization: {utilization}", file=sys.stderr)
 
+    solver_ab = None
+    if on_tpu and os.environ.get("BENCH_SOLVER_AB", "1") != "0":
+        # on real hardware, also time the scatter-based segment solver at a
+        # REDUCED workload (it is ~orders slower there — docs/perf_roofline
+        # .md) so every TPU artifact carries the dense-vs-segment evidence
+        import predictionio_tpu.models.als as als_mod
+
+        ab_ratings = min(n_ratings, 2_000_000)
+        ab_iters = 2
+        try:
+            inter_ab = _make_interactions(
+                primary_dist, n_users, n_items, ab_ratings
+            )
+            results_ab = {}
+            for solver in ("dense", "segment"):
+                cfg = als_mod.ALSConfig(
+                    rank=rank, iterations=1, compute_dtype=dtype,
+                    solver=solver,
+                )
+                als_mod.train_als(ctx, inter_ab, cfg)  # compile
+                t0 = time.perf_counter()
+                als_mod.train_als(
+                    ctx, inter_ab,
+                    als_mod.ALSConfig(
+                        rank=rank, iterations=ab_iters,
+                        compute_dtype=dtype, solver=solver,
+                    ),
+                )
+                dt = time.perf_counter() - t0
+                results_ab[solver] = round(
+                    ab_ratings * ab_iters / dt / n_chips, 1
+                )
+            solver_ab = {
+                **results_ab,
+                "speedup_dense_vs_segment": round(
+                    results_ab["dense"] / results_ab["segment"], 2
+                ),
+                "workload_ratings": ab_ratings,
+                "iterations": ab_iters,
+            }
+            print(f"INFO: solver A/B: {solver_ab}", file=sys.stderr)
+        except Exception as e:  # the A/B must never kill the artifact
+            print(f"WARNING: solver A/B failed: {e}", file=sys.stderr)
+            solver_ab = {"error": str(e)}
+
     latency = None
     if os.environ.get("BENCH_SERVING", "1") != "0":
         # serving benches must never kill the artifact: the training number
@@ -367,6 +412,9 @@ def main() -> None:
         },
     }
     record["utilization"] = utilization
+    record["solver"] = os.environ.get("PIO_ALS_SOLVER", "dense")
+    if solver_ab is not None:
+        record["solver_ab"] = solver_ab
     if latency is not None:
         record["predict_latency_ms"] = latency
     if "zipf" in results and primary_dist != "zipf":

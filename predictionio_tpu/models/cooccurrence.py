@@ -531,10 +531,12 @@ def _train_cooccurrence_sharded(
         item_map=sh.item_map,
     )
     n_local_users = max(len(uniq), 1)
-    # disjoint users ⇒ local distinct-count histograms sum exactly
-    pc = distributed.host_sum(distinct_item_counts(local, n_items_total))
     k = min(n, n_items_total)
     if n_items_total > DENSE_ITEM_LIMIT:
+        # disjoint users ⇒ local distinct-count histograms sum exactly to
+        # the global LLR marginals (the dense branch reads them off
+        # diag(C) instead — no extra pass or collective there)
+        pc = distributed.host_sum(distinct_item_counts(local, n_items_total))
         idx, vals = cross_occurrence_topn(
             ctx, local, local, n_items_total, n_items_total,
             n_users=n_local_users, k=k, use_llr=use_llr,

@@ -203,7 +203,6 @@ def template_interactions(
     ``read_eval``'s row-level fold split needs every row on every host).
     """
     from predictionio_tpu.data import store as store_mod
-    from predictionio_tpu.data.batch import merge_interactions
 
     if (
         not force_local
@@ -220,13 +219,26 @@ def template_interactions(
             **find_kwargs,
         )
     if parts is not None:
-        reads = [
-            store_mod.PEventStore.find_interactions(app_name, **p)
-            for p in parts
-        ]
-        reads = [r for r in reads if len(r)] or reads[:1]
-        return reads[0] if len(reads) == 1 else merge_interactions(reads)
-    return store_mod.PEventStore.find_interactions(app_name, **find_kwargs)
+        return _merge_part_reads(
+            lambda p: store_mod.PEventStore.find_interactions(
+                app_name, channel_name=channel_name, **p
+            ),
+            parts,
+        )
+    return store_mod.PEventStore.find_interactions(
+        app_name, channel_name=channel_name, **find_kwargs
+    )
+
+
+def _merge_part_reads(read_fn, part_kwargs: list):
+    """Read one Interactions per filter dict, drop empties, merge the rest
+    into shared id maps (one policy for BOTH the sharded passes and the
+    single-host template reads — keep them from drifting)."""
+    from predictionio_tpu.data.batch import merge_interactions
+
+    reads = [read_fn(p) for p in part_kwargs]
+    reads = [r for r in reads if len(r.rating)] or reads[:1]
+    return reads[0] if len(reads) == 1 else merge_interactions(reads)
 
 
 def _resolve_rendezvous(run_key, process_index, num_processes):
@@ -317,22 +329,18 @@ def read_sharded_interactions(
     user pass (every row appears in exactly one host's user pass), so
     ingest halves to one 1/N scan per host and ``item_rows`` is empty.
     """
-    from predictionio_tpu.data.batch import merge_interactions
-
     pid, n, key = _resolve_rendezvous(run_key, process_index, num_processes)
     pe = storage.get_p_events()
     part_kwargs = parts if parts is not None else [find_kwargs]
 
     def read_pass(shard_key: str) -> Interactions:
-        reads = [
-            pe.find_interactions(
+        return _merge_part_reads(
+            lambda p: pe.find_interactions(
                 app_id, channel_id=channel_id, shard=(pid, n),
                 shard_key=shard_key, **p,
-            )
-            for p in part_kwargs
-        ]
-        reads = [r for r in reads if len(r.rating)] or reads[:1]
-        return reads[0] if len(reads) == 1 else merge_interactions(reads)
+            ),
+            part_kwargs,
+        )
 
     upass = read_pass("entity")
     ipass = read_pass("target") if item_pass else None
