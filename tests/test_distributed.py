@@ -706,3 +706,60 @@ print("OK deployed similarproduct answers", [s.item for s in result.itemScores])
 """,
     )
     assert "OK deployed similarproduct answers" in out
+
+
+@pytest.mark.slow
+def test_two_process_host_sum_slabbed(tmp_path):
+    """host_sum must reduce identically through the whole-array and the
+    slab-chunked paths (large arrays reduce in row slabs to bound peak
+    memory) under REAL multi-process execution."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        f"""
+import os, sys
+sys.path.insert(0, {REPO!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from predictionio_tpu.parallel import distributed
+
+assert distributed.initialize()
+pid = distributed.process_index()
+x = np.arange(40, dtype=np.float64).reshape(8, 5) * (pid + 1)
+want = x / (pid + 1) * 3  # host0 (×1) + host1 (×2) = ×3
+whole = distributed.host_sum(x)
+np.testing.assert_allclose(whole, want)
+distributed._HOST_SUM_SLAB_ELEMS = 10  # force ~2-row slabs
+slabbed = distributed.host_sum(x)
+np.testing.assert_allclose(slabbed, want)
+print("HOSTSUM OK", pid)
+"""
+    )
+
+    def launch(pid, port):
+        env = dict(os.environ)
+        env.update(
+            {
+                "PIO_COORDINATOR": f"127.0.0.1:{port}",
+                "PIO_NUM_PROCESSES": "2",
+                "PIO_PROCESS_ID": str(pid),
+            }
+        )
+        return subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    port = free_port()
+    procs = [launch(0, port), launch(1, port)]
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            assert p.returncode == 0, out
+            assert "HOSTSUM OK" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
