@@ -98,6 +98,39 @@ def write_engine_json(tmp_path, app_name: str, algo_params: dict) -> None:
     )
 
 
+def launch_worker(script, pid: int, port: int) -> subprocess.Popen:
+    """Spawn one PIO_COORDINATOR-contract worker running ``script``."""
+    env = dict(os.environ)
+    env.update(
+        {
+            "PIO_COORDINATOR": f"127.0.0.1:{port}",
+            "PIO_NUM_PROCESSES": "2",
+            "PIO_PROCESS_ID": str(pid),
+        }
+    )
+    return subprocess.Popen(
+        [sys.executable, str(script)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def run_worker_pair(script, timeout: int = 180) -> list[str]:
+    """Run a script as 2 coordinated processes; return their outputs."""
+    port = free_port()
+    procs = [launch_worker(script, 0, port), launch_worker(script, 1, port)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+            assert p.returncode == 0, out
+    finally:
+        for p in procs:  # never leak workers stuck in the rendezvous
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
 def assert_one_completed(tmp_path, env, allow_others: bool = False) -> None:
     """Exactly one COMPLETED instance with a model blob; by default also NO
     other instances (the coordinator-gating contract — a stray worker write
@@ -152,37 +185,9 @@ print(f"RESULT {{distributed.process_index()}} {{n}} {{result}}")
 
 @pytest.mark.slow
 def test_two_process_mesh_psum(tmp_path):
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     script = tmp_path / "worker.py"
     script.write_text(WORKER.format(repo=REPO))
-
-    def launch(pid):
-        env = dict(os.environ)
-        env.update(
-            {
-                "PIO_COORDINATOR": f"127.0.0.1:{port}",
-                "PIO_NUM_PROCESSES": "2",
-                "PIO_PROCESS_ID": str(pid),
-            }
-        )
-        return subprocess.Popen(
-            [sys.executable, str(script)], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-
-    procs = [launch(0), launch(1)]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out)
-            assert p.returncode == 0, out
-    finally:
-        for p in procs:  # never leak workers stuck in the rendezvous
-            if p.poll() is None:
-                p.kill()
+    outs = run_worker_pair(script)
     for out in outs:
         line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
         _, pid, n, result = line.split()
@@ -737,29 +742,5 @@ np.testing.assert_allclose(slabbed, want)
 print("HOSTSUM OK", pid)
 """
     )
-
-    def launch(pid, port):
-        env = dict(os.environ)
-        env.update(
-            {
-                "PIO_COORDINATOR": f"127.0.0.1:{port}",
-                "PIO_NUM_PROCESSES": "2",
-                "PIO_PROCESS_ID": str(pid),
-            }
-        )
-        return subprocess.Popen(
-            [sys.executable, str(script)], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-
-    port = free_port()
-    procs = [launch(0, port), launch(1, port)]
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)
-            assert p.returncode == 0, out
-            assert "HOSTSUM OK" in out
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    for out in run_worker_pair(script):
+        assert "HOSTSUM OK" in out
