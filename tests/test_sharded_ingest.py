@@ -120,9 +120,12 @@ class TestExchange:
 
 
 class TestShardedPermutation:
-    def test_bijection_owner_locality_and_monotone_degrees(self):
+    @pytest.mark.parametrize("n_hosts,d_local", [(2, 4), (3, 2), (4, 1)])
+    def test_bijection_owner_locality_and_monotone_degrees(
+        self, n_hosts, d_local
+    ):
         rng = np.random.default_rng(0)
-        n, n_hosts, d_local = 37, 2, 4
+        n = 37
         counts = rng.integers(1, 100, n)
         owner = rng.integers(0, n_hosts, n)
         per_shard = max(
@@ -141,6 +144,38 @@ class TestShardedPermutation:
         deg[perm[:n]] = counts
         deg = deg.reshape(n_hosts * d_local, per_shard)
         assert all(np.all(np.diff(row) <= 0) for row in deg)
+
+    def test_host_with_no_entities(self):
+        # one host owns nothing: its shards become pure padding, the
+        # permutation stays a bijection and peers are unaffected
+        counts = np.array([5, 3, 2], np.int64)
+        owner = np.array([0, 0, 0], np.int64)
+        perm = _sharded_balance_permutation(counts, owner, 2, 2, 2)
+        assert sorted(perm) == list(range(8))
+        assert set(perm[:3] // 2) <= {0, 1}  # all on host 0's shards
+
+
+class TestBucketBoundaries:
+    def test_edge_shapes(self):
+        from predictionio_tpu.models.als import _bucket_boundaries
+
+        # all-zero degrees: one floor-width bucket chain, full coverage
+        bounds = _bucket_boundaries(np.zeros(10, np.int64), 1 << 20)
+        assert bounds[0][2] == 8 and bounds[-1][1] == 10
+        # a single giant entity followed by a tail
+        dmax = np.array([100_000, 9, 9, 1, 0], np.int64)
+        bounds = _bucket_boundaries(dmax, 1 << 22)
+        assert bounds[0] == (0, 1, 100_000)  # giant isolated, pad8 width
+        # coverage is contiguous and complete
+        assert bounds[0][0] == 0 and bounds[-1][1] == len(dmax)
+        for (a, b, _), (c, d, _) in zip(bounds, bounds[1:]):
+            assert b == c
+        # every member's degree fits its bucket width
+        for j0, j1, width in bounds:
+            assert int(dmax[j0:j1].max(initial=0)) <= width
+        # chunk budget splits buckets rather than exceeding it
+        tight = _bucket_boundaries(np.full(100, 8, np.int64), 64)
+        assert all((j1 - j0) * w <= 64 for j0, j1, w in tight)
 
 
 class TestShardedTrain:
