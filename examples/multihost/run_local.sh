@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# Multi-host training quickstart, runnable on ONE machine: `pio launch`
+# spawns N coordinated processes under the PIO_COORDINATOR contract —
+# exactly how N real hosts run (each host executes the same `pio train`,
+# meshes span processes, ingest is 1/N per process with entity-keyed
+# DAO shard pushdown; see docs/operations.md "Multi-host training").
+#
+# Usage:  examples/multihost/run_local.sh [num_processes]
+set -euo pipefail
+N="${1:-2}"
+HERE="$(cd "$(dirname "$0")"; pwd)"
+REPO="$(cd "$HERE/../.."; pwd)"
+WORK="$(mktemp -d)"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+# CPU-simulated chips so the example runs anywhere; on a real TPU pod,
+# drop these two lines and run one process per host via --hosts
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=2"
+export PIO_STORAGE_SOURCES_DB_TYPE=sqlite
+export PIO_STORAGE_SOURCES_DB_PATH="$WORK/pio.sqlite"
+export PIO_STORAGE_REPOSITORIES_METADATA_SOURCE=DB
+export PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=DB
+export PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE=DB
+export PIO_BASE_DIR="$WORK/base"
+PIO="python -m predictionio_tpu.tools.cli"
+
+echo "== seed events =="
+$PIO app new mhapp >/dev/null
+python - << 'PY'
+import os, numpy as np
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.data import Event
+st = Storage.instance()
+app = st.get_meta_data_apps().get_by_name("mhapp")
+le = st.get_l_events(); le.init(app.id)
+rng = np.random.default_rng(0)
+evs = [Event(event="rate", entity_type="user", entity_id=f"u{u}",
+             target_entity_type="item", target_entity_id=f"i{i}",
+             properties={"rating": float(rng.integers(1, 6))})
+       for u in range(40) for i in rng.choice(15, 5, replace=False)]
+le.batch_insert(evs, app.id)
+print(f"seeded {len(evs)} events")
+PY
+
+echo "== engine.json =="
+cd "$WORK"
+cat > engine.json << 'JSON'
+{"id": "default",
+ "engineFactory": "predictionio_tpu.templates.recommendation.RecommendationEngine",
+ "datasource": {"params": {"appName": "mhapp"}},
+ "algorithms": [{"name": "als", "params": {"rank": 4, "numIterations": 3}}]}
+JSON
+
+echo "== pio launch -n $N -- train  (watch the [p<i>] prefixes and the"
+echo "   'sharded ingest pI/N: ...' lines: each process reads 1/N) =="
+# a free port per run: a stale coordinator on the default port must not
+# break the example (same free_port convention the test suite uses)
+PORT=$(python -c "import socket; s=socket.socket(); s.bind(('127.0.0.1',0)); print(s.getsockname()[1]); s.close()")
+$PIO launch -n "$N" --coordinator-port "$PORT" -- --verbose train 2>&1 \
+  | tee "$WORK/train.log" \
+  | grep -E "\[p[0-9]\] .*(sharded ingest|Training completed)" || true
+grep -q "all $N processes completed" "$WORK/train.log"
+
+echo "== exactly one COMPLETED instance (coordinator-only writes) =="
+python - << 'PY'
+from predictionio_tpu.data.storage.registry import Storage
+ei = Storage.instance().get_meta_data_engine_instances()
+done = [i for i in ei.get_all() if i.status == ei.STATUS_COMPLETED]
+print(f"COMPLETED instances: {len(done)} (ids: {[i.id for i in done]})")
+PY
+echo "workdir: $WORK"

@@ -42,3 +42,17 @@ def test_scripts_have_help(exdir):
             capture_output=True, timeout=60,
         )
         assert r.returncode == 0, r.stderr.decode()
+
+
+@pytest.mark.slow
+def test_multihost_example_runs():
+    """examples/multihost/run_local.sh is runnable documentation: launches
+    a real 2-process coordinated train and shows the 1/N ingest lines."""
+    script = EXAMPLES / "multihost" / "run_local.sh"
+    r = subprocess.run(
+        ["bash", str(script), "2"], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    assert "sharded ingest p0/2" in r.stdout
+    assert "COMPLETED instances: 1" in r.stdout
