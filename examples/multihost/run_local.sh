@@ -11,6 +11,9 @@ N="${1:-2}"
 HERE="$(cd "$(dirname "$0")"; pwd)"
 REPO="$(cd "$HERE/../.."; pwd)"
 WORK="$(mktemp -d)"
+# KEEP=1 examples/multihost/run_local.sh  — keep the workdir for inspection
+if [ "${KEEP:-0}" != "1" ]; then trap 'rm -rf "$WORK"' EXIT; fi
+PYTHON="${PIO_PYTHON:-$(command -v python3 || command -v python)}"
 export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
 # CPU-simulated chips so the example runs anywhere; on a real TPU pod,
 # drop these two lines and run one process per host via --hosts
@@ -22,11 +25,11 @@ export PIO_STORAGE_REPOSITORIES_METADATA_SOURCE=DB
 export PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=DB
 export PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE=DB
 export PIO_BASE_DIR="$WORK/base"
-PIO="python -m predictionio_tpu.tools.cli"
+PIO="$PYTHON -m predictionio_tpu.tools.cli"
 
 echo "== seed events =="
 $PIO app new mhapp >/dev/null
-python - << 'PY'
+$PYTHON - << 'PY'
 import os, numpy as np
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.data import Event
@@ -55,17 +58,17 @@ echo "== pio launch -n $N -- train  (watch the [p<i>] prefixes and the"
 echo "   'sharded ingest pI/N: ...' lines: each process reads 1/N) =="
 # a free port per run: a stale coordinator on the default port must not
 # break the example (same free_port convention the test suite uses)
-PORT=$(python -c "import socket; s=socket.socket(); s.bind(('127.0.0.1',0)); print(s.getsockname()[1]); s.close()")
+PORT=$($PYTHON -c "import socket; s=socket.socket(); s.bind(('127.0.0.1',0)); print(s.getsockname()[1]); s.close()")
 $PIO launch -n "$N" --coordinator-port "$PORT" -- --verbose train 2>&1 \
   | tee "$WORK/train.log" \
   | grep -E "\[p[0-9]\] .*(sharded ingest|Training completed)" || true
 grep -q "all $N processes completed" "$WORK/train.log"
 
 echo "== exactly one COMPLETED instance (coordinator-only writes) =="
-python - << 'PY'
+$PYTHON - << 'PY'
 from predictionio_tpu.data.storage.registry import Storage
 ei = Storage.instance().get_meta_data_engine_instances()
 done = [i for i in ei.get_all() if i.status == ei.STATUS_COMPLETED]
 print(f"COMPLETED instances: {len(done)} (ids: {[i.id for i in done]})")
 PY
-echo "workdir: $WORK"
+if [ "${KEEP:-0}" = "1" ]; then echo "workdir kept: $WORK"; fi

@@ -5,6 +5,13 @@ input line; each line is parsed → supplemented → predicted per algorithm →
 served → rendered as one JSON line.  Where the reference maps over a query
 RDD on Spark executors, this streams through the in-process engine (the
 per-query predict itself runs on-device for sharded models).
+
+Multi-host (``pio launch -- batchpredict``): the reference's RDD map IS
+distributed, and so is this — each process takes the input lines with
+``line_index % N == process_index`` and writes ``<output>.part-<i>``
+(Spark ``saveAsTextFile`` part-file semantics); single-host writes
+``<output>`` directly. Every process deploys the same COMPLETED instance,
+so results are identical to a single-host run, just split N ways.
 """
 
 from __future__ import annotations
@@ -25,6 +32,15 @@ from predictionio_tpu.serving.query_server import _to_jsonable, bind_query
 logger = logging.getLogger(__name__)
 
 
+def _remove_quiet(path: str) -> None:
+    import os
+
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass
+
+
 def run_batch_predict(
     engine: Engine,
     input_path: str,
@@ -34,10 +50,41 @@ def run_batch_predict(
     engine_id: str = "default",
     engine_version: str = "default",
     engine_variant: str = "default",
-) -> int:
-    """Returns the number of predictions written."""
+) -> tuple[int, str]:
+    """Returns (predictions written by THIS process, the path it wrote)."""
+    import glob
+    import os
+    import re
+
+    from predictionio_tpu.parallel import distributed
+
     storage = storage or Storage.instance()
     ctx = ctx or MeshContext.create()
+    pid, n_procs = 0, 1
+    base_output = output_path
+    # stale-output hygiene (Spark refuses an existing output dir; here we
+    # remove exactly the files no CURRENT process will rewrite, so a
+    # re-run with different N can never mix runs): part-j for j >= N is
+    # owned by nobody now, and the PLAIN file is only written single-host
+    stale = [
+        p for p in glob.glob(f"{base_output}.part-*")
+        if re.search(r"\.part-(\d+)$", p)
+    ]
+    if distributed.is_initialized() and distributed.num_processes() > 1:
+        pid, n_procs = distributed.process_index(), distributed.num_processes()
+        output_path = f"{base_output}.part-{pid}"
+        logger.info(
+            "batch predict p%d/%d: lines %%%d == %d -> %s",
+            pid, n_procs, n_procs, pid, output_path,
+        )
+        for p in stale:
+            if int(re.search(r"\.part-(\d+)$", p).group(1)) >= n_procs:
+                _remove_quiet(p)
+        if pid == 0:
+            _remove_quiet(base_output)
+    else:
+        for p in stale:
+            _remove_quiet(p)
     instance = get_latest_completed_instance(
         storage, engine_id, engine_version, engine_variant
     )
@@ -47,6 +94,8 @@ def run_batch_predict(
     n = 0
     with open(input_path) as fin, open(output_path, "w") as fout:
         for line_no, line in enumerate(fin, 1):
+            if n_procs > 1 and (line_no - 1) % n_procs != pid:
+                continue
             line = line.strip()
             if not line:
                 continue
@@ -68,4 +117,4 @@ def run_batch_predict(
             except Exception as e:
                 logger.warning("line %d failed: %s", line_no, e)
                 fout.write(json.dumps({"query": line, "error": str(e)}) + "\n")
-    return n
+    return n, output_path

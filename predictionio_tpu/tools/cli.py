@@ -396,7 +396,7 @@ def cmd_batchpredict(args) -> int:
     variant = load_variant(args)
     engine = resolve_engine_from_variant(variant)
     engine_id, engine_version, engine_variant = engine_identity(variant)
-    n = run_batch_predict(
+    n, written = run_batch_predict(
         engine,
         args.input,
         args.output,
@@ -406,7 +406,9 @@ def cmd_batchpredict(args) -> int:
         engine_version=engine_version,
         engine_variant=engine_variant,
     )
-    print(f"[INFO] Batch predict completed: {n} predictions -> {args.output}")
+    # `written` is the ACTUAL path this process wrote (a .part-<i> file
+    # under a multi-host launch), not the requested base path
+    print(f"[INFO] Batch predict completed: {n} predictions -> {written}")
     return 0
 
 

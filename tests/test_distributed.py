@@ -744,3 +744,58 @@ print("HOSTSUM OK", pid)
     )
     for out in run_worker_pair(script):
         assert "HOSTSUM OK" in out
+
+
+@pytest.mark.slow
+def test_two_process_batch_predict_parts(tmp_path):
+    """`pio launch -- batchpredict`: the reference's RDD map is distributed,
+    so is this — each process scores its 1/N of the input lines and writes
+    a part file; the parts together cover every query exactly once."""
+    import json as jsonlib
+
+    env = sqlite_env(tmp_path)
+    seed_ratings(tmp_path, env, "bpapp")
+    write_engine_json(tmp_path, "bpapp", {"rank": 3, "numIterations": 2})
+    # single-host train first (the model to batch-predict with)
+    r = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.tools.cli", "train"],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=240,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    queries = tmp_path / "queries.jsonl"
+    queries.write_text(
+        "".join(
+            jsonlib.dumps({"user": f"u{u}", "num": 3}) + "\n"
+            for u in range(9)
+        )
+    )
+    out = tmp_path / "preds.jsonl"
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "predictionio_tpu.tools.cli", "launch",
+            "-n", "2", "--coordinator-port", str(free_port()), "--",
+            "batchpredict", "--input", str(queries), "--output", str(out),
+        ],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    parts = sorted(tmp_path.glob("preds.jsonl.part-*"))
+    assert [p.name for p in parts] == [
+        "preds.jsonl.part-0", "preds.jsonl.part-1"
+    ]
+    rows = [
+        jsonlib.loads(line)
+        for p in parts
+        for line in p.read_text().splitlines()
+    ]
+    users = sorted(r["query"]["user"] for r in rows)
+    assert users == sorted(f"u{u}" for u in range(9))  # disjoint + covering
+    assert all(r["prediction"]["itemScores"] for r in rows)
+    # the split is the documented line_index % N rule
+    p0_users = {
+        jsonlib.loads(line)["query"]["user"]
+        for line in parts[0].read_text().splitlines()
+    }
+    assert p0_users == {f"u{u}" for u in range(0, 9, 2)}

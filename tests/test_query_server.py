@@ -413,14 +413,14 @@ class TestBatchPredict:
                 ]
             )
         )
-        n = run_batch_predict(
+        n, written = run_batch_predict(
             trained["engine"],
             str(inp),
             str(out),
             storage=trained["storage"],
             ctx=trained["ctx"],
         )
-        assert n == 2
+        assert n == 2 and written == str(out)
         lines = [json.loads(l) for l in out.read_text().splitlines()]
         assert len(lines) == 3  # 2 ok + 1 error line
         assert len(lines[0]["prediction"]["itemScores"]) == 2
