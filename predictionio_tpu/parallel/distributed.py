@@ -126,6 +126,49 @@ def host_sum(x):
     return out
 
 
+def _remove_quiet(path: str) -> None:
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass
+
+
+def shard_output_path(base_path: str) -> tuple[int, int, str]:
+    """The distributed-writer output contract (batch predict, export).
+
+    Returns ``(process_index, num_processes, path_THIS_process_writes)``:
+    ``<base>.part-<i>`` under a multi-host launch (Spark ``saveAsTextFile``
+    part semantics), the plain base single-host. Also removes exactly the
+    stale outputs no CURRENT process will rewrite — part-j for j ≥ N, the
+    plain base under multi-host (coordinator), every part single-host — so
+    a re-run with a different N can never mix runs when consumers glob
+    ``<base>*``.
+    """
+    import glob
+    import re
+
+    pid, n = 0, 1
+    if is_initialized() and num_processes() > 1:
+        pid, n = process_index(), num_processes()
+    stale = [
+        p
+        for p in glob.glob(glob.escape(base_path) + ".part-*")
+        if re.search(r"\.part-(\d+)$", p)
+    ]
+    if n > 1:
+        out = f"{base_path}.part-{pid}"
+        for p in stale:
+            if int(re.search(r"\.part-(\d+)$", p).group(1)) >= n:
+                _remove_quiet(p)
+        if pid == 0:
+            _remove_quiet(base_path)
+    else:
+        out = base_path
+        for p in stale:
+            _remove_quiet(p)
+    return pid, n, out
+
+
 def run_id() -> Optional[str]:
     """The launch-scoped unique id (set by ``pio launch`` on every worker).
 

@@ -32,15 +32,6 @@ from predictionio_tpu.serving.query_server import _to_jsonable, bind_query
 logger = logging.getLogger(__name__)
 
 
-def _remove_quiet(path: str) -> None:
-    import os
-
-    try:
-        os.remove(path)
-    except FileNotFoundError:
-        pass
-
-
 def run_batch_predict(
     engine: Engine,
     input_path: str,
@@ -52,39 +43,18 @@ def run_batch_predict(
     engine_variant: str = "default",
 ) -> tuple[int, str]:
     """Returns (predictions written by THIS process, the path it wrote)."""
-    import glob
-    import os
-    import re
-
     from predictionio_tpu.parallel import distributed
 
     storage = storage or Storage.instance()
     ctx = ctx or MeshContext.create()
-    pid, n_procs = 0, 1
-    base_output = output_path
-    # stale-output hygiene (Spark refuses an existing output dir; here we
-    # remove exactly the files no CURRENT process will rewrite, so a
-    # re-run with different N can never mix runs): part-j for j >= N is
-    # owned by nobody now, and the PLAIN file is only written single-host
-    stale = [
-        p for p in glob.glob(f"{base_output}.part-*")
-        if re.search(r"\.part-(\d+)$", p)
-    ]
-    if distributed.is_initialized() and distributed.num_processes() > 1:
-        pid, n_procs = distributed.process_index(), distributed.num_processes()
-        output_path = f"{base_output}.part-{pid}"
+    # part-file path + stale-output hygiene: the shared distributed-writer
+    # contract (a re-run with different N can never mix runs)
+    pid, n_procs, output_path = distributed.shard_output_path(output_path)
+    if n_procs > 1:
         logger.info(
             "batch predict p%d/%d: lines %%%d == %d -> %s",
             pid, n_procs, n_procs, pid, output_path,
         )
-        for p in stale:
-            if int(re.search(r"\.part-(\d+)$", p).group(1)) >= n_procs:
-                _remove_quiet(p)
-        if pid == 0:
-            _remove_quiet(base_output)
-    else:
-        for p in stale:
-            _remove_quiet(p)
     instance = get_latest_completed_instance(
         storage, engine_id, engine_version, engine_variant
     )

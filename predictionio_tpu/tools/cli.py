@@ -565,8 +565,10 @@ def cmd_upgrade(args) -> int:
 def cmd_export(args) -> int:
     from predictionio_tpu.tools.export_import import export_events
 
-    n = export_events(_storage(), args.appid, args.output, channel=args.channel)
-    print(f"[INFO] Exported {n} events to {args.output}")
+    n, written = export_events(
+        _storage(), args.appid, args.output, channel=args.channel
+    )
+    print(f"[INFO] Exported {n} events to {written}")
     return 0
 
 

@@ -28,16 +28,31 @@ def _channel_id(storage: Storage, app_id: int, channel: Optional[str]) -> Option
 
 def export_events(
     storage: Storage, app_id: int, output_path: str, channel: Optional[str] = None
-) -> int:
-    """Stream the columnar bulk read out as JSON lines (rows built lazily)."""
+) -> tuple[int, str]:
+    """Stream the columnar bulk read out as JSON lines (rows built lazily).
+
+    Multi-host (``pio launch -- export``): the reference's export is a
+    Spark job writing ``part-NNNNN`` files; here each process pulls its
+    1/N of the rows with row-keyed DAO shard pushdown and writes
+    ``<output>.part-<i>`` — N hosts each scan and serialize 1/N.
+    Returns (rows written by THIS process, the path it wrote).
+    """
+    from predictionio_tpu.parallel import distributed
+
     channel_id = _channel_id(storage, app_id, channel)
-    batch = storage.get_p_events().find(app_id, channel_id=channel_id)
+    # part-file path + stale-output hygiene: the shared distributed-writer
+    # contract (see distributed.shard_output_path)
+    pid, n_procs, output_path = distributed.shard_output_path(output_path)
+    shard = (pid, n_procs) if n_procs > 1 else None
+    batch = storage.get_p_events().find(
+        app_id, channel_id=channel_id, shard=shard
+    )
     n = 0
     with open(output_path, "w") as f:
         for e in batch:  # EventBatch materializes one row at a time
             f.write(e.to_json() + "\n")
             n += 1
-    return n
+    return n, output_path
 
 
 IMPORT_CHUNK = 10_000

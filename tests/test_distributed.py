@@ -799,3 +799,44 @@ def test_two_process_batch_predict_parts(tmp_path):
         for line in parts[0].read_text().splitlines()
     }
     assert p0_users == {f"u{u}" for u in range(0, 9, 2)}
+
+
+@pytest.mark.slow
+def test_two_process_export_parts(tmp_path):
+    """`pio launch -- export`: the reference's export is a Spark job writing
+    part files; each process here scans 1/N (row-keyed pushdown) and writes
+    its part — disjoint, covering, valid event JSON lines."""
+    import json as jsonlib
+
+    env = sqlite_env(tmp_path)
+    seed_ratings(tmp_path, env, "exapp")
+    app_id = int(run_py(
+        tmp_path, env, """
+from predictionio_tpu.data.storage.registry import Storage
+print(Storage.instance().get_meta_data_apps().get_by_name("exapp").id)
+""",
+    ).strip().splitlines()[-1])
+    out = tmp_path / "events.jsonl"
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "predictionio_tpu.tools.cli", "launch",
+            "-n", "2", "--coordinator-port", str(free_port()), "--",
+            "export", "--appid", str(app_id), "--output", str(out),
+        ],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    parts = sorted(tmp_path.glob("events.jsonl.part-*"))
+    assert [p.name for p in parts] == [
+        "events.jsonl.part-0", "events.jsonl.part-1"
+    ]
+    rows = [
+        jsonlib.loads(line)
+        for p in parts
+        for line in p.read_text().splitlines()
+    ]
+    assert len(rows) == 120  # 30 users × 4 ratings, disjoint + covering
+    assert len({e["eventId"] for e in rows}) == 120
+    sizes = [len(p.read_text().splitlines()) for p in parts]
+    assert all(s == 60 for s in sizes)  # row-keyed split is even
