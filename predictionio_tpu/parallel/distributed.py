@@ -126,6 +126,15 @@ def host_sum(x):
     return out
 
 
+def process_slot() -> tuple[int, int]:
+    """(process_index, num_processes) under an active multi-host launch,
+    (0, 1) otherwise — the one multi-host detection rule every distributed
+    reader/writer shares."""
+    if is_initialized() and num_processes() > 1:
+        return process_index(), num_processes()
+    return 0, 1
+
+
 def _remove_quiet(path: str) -> None:
     try:
         os.remove(path)
@@ -147,9 +156,7 @@ def shard_output_path(base_path: str) -> tuple[int, int, str]:
     import glob
     import re
 
-    pid, n = 0, 1
-    if is_initialized() and num_processes() > 1:
-        pid, n = process_index(), num_processes()
+    pid, n = process_slot()
     stale = [
         p
         for p in glob.glob(glob.escape(base_path) + ".part-*")

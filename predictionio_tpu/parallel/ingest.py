@@ -204,11 +204,7 @@ def template_interactions(
     """
     from predictionio_tpu.data import store as store_mod
 
-    if (
-        not force_local
-        and distributed.is_initialized()
-        and distributed.num_processes() > 1
-    ):
+    if not force_local and distributed.process_slot()[1] > 1:
         app_id, channel_id = store_mod.resolve_app(app_name, channel_name)
         return read_sharded_interactions(
             store_mod.get_storage(),

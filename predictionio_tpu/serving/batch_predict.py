@@ -47,20 +47,23 @@ def run_batch_predict(
 
     storage = storage or Storage.instance()
     ctx = ctx or MeshContext.create()
-    # part-file path + stale-output hygiene: the shared distributed-writer
-    # contract (a re-run with different N can never mix runs)
-    pid, n_procs, output_path = distributed.shard_output_path(output_path)
-    if n_procs > 1:
-        logger.info(
-            "batch predict p%d/%d: lines %%%d == %d -> %s",
-            pid, n_procs, n_procs, pid, output_path,
-        )
+    pid, n_procs = distributed.process_slot()
+    # the FALLIBLE deploy runs before output hygiene: a failed run must
+    # leave the previous outputs untouched
     instance = get_latest_completed_instance(
         storage, engine_id, engine_version, engine_variant
     )
     _, algorithms, serving, models = prepare_deploy(
         engine, instance, storage=storage, ctx=ctx
     )
+    # part-file path + stale-output hygiene: the shared distributed-writer
+    # contract (a re-run with different N can never mix runs)
+    _, _, output_path = distributed.shard_output_path(output_path)
+    if n_procs > 1:
+        logger.info(
+            "batch predict p%d/%d: lines %%%d == %d -> %s",
+            pid, n_procs, n_procs, pid, output_path,
+        )
     n = 0
     with open(input_path) as fin, open(output_path, "w") as fout:
         for line_no, line in enumerate(fin, 1):

@@ -153,7 +153,7 @@ class RecommendationDataSource(SelfCleaningDataSource, DataSource):
         from predictionio_tpu.parallel import distributed
 
         multihost = (
-            distributed.is_initialized() and distributed.num_processes() > 1
+            distributed.process_slot()[1] > 1
         )
         if multihost and self.params.eventWindow:
             # the window cleaner REWRITES the event store in place

@@ -105,7 +105,7 @@ class URDataSource(DataSource):
     def read_training(self, ctx) -> TrainingData:
         from predictionio_tpu.parallel import distributed
 
-        if distributed.is_initialized() and distributed.num_processes() > 1:
+        if distributed.process_slot()[1] > 1:
             return self._read_training_sharded()
         # one store scan for ALL event types, split per name afterwards
         batch = PEventStore.find(

@@ -40,13 +40,16 @@ def export_events(
     from predictionio_tpu.parallel import distributed
 
     channel_id = _channel_id(storage, app_id, channel)
-    # part-file path + stale-output hygiene: the shared distributed-writer
-    # contract (see distributed.shard_output_path)
-    pid, n_procs, output_path = distributed.shard_output_path(output_path)
+    pid, n_procs = distributed.process_slot()
     shard = (pid, n_procs) if n_procs > 1 else None
+    # the FALLIBLE scan runs before output hygiene: a failed export must
+    # leave the previous good export files untouched
     batch = storage.get_p_events().find(
         app_id, channel_id=channel_id, shard=shard
     )
+    # part-file path + stale-output hygiene: the shared distributed-writer
+    # contract (see distributed.shard_output_path)
+    _, _, output_path = distributed.shard_output_path(output_path)
     n = 0
     with open(output_path, "w") as f:
         for e in batch:  # EventBatch materializes one row at a time
@@ -61,14 +64,27 @@ IMPORT_CHUNK = 10_000
 def import_events(
     storage: Storage, app_id: int, input_path: str, channel: Optional[str] = None
 ) -> int:
-    """Chunked inserts: bounded memory however large the file is."""
+    """Chunked inserts: bounded memory however large the file is.
+
+    Multi-host (``pio launch -- import``): the reference's FileToEvents is
+    a Spark job too — each process here inserts the lines with
+    ``line_index % N == process_index`` (events carry their eventIds, so
+    the split is exact and re-imports stay idempotent). Point the storage
+    at a shared backend (`network` driver or a shared filesystem) and N
+    hosts ingest concurrently.
+    """
+    from predictionio_tpu.parallel import distributed
+
+    pid, n_procs = distributed.process_slot()
     channel_id = _channel_id(storage, app_id, channel)
     le = storage.get_l_events()
     le.init(app_id, channel_id)
     n = 0
     chunk: list[Event] = []
     with open(input_path) as f:
-        for line in f:
+        for line_no, line in enumerate(f):
+            if n_procs > 1 and line_no % n_procs != pid:
+                continue
             line = line.strip()
             if not line:
                 continue

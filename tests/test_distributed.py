@@ -840,3 +840,63 @@ print(Storage.instance().get_meta_data_apps().get_by_name("exapp").id)
     assert len({e["eventId"] for e in rows}) == 120
     sizes = [len(p.read_text().splitlines()) for p in parts]
     assert all(s == 60 for s in sizes)  # row-keyed split is even
+
+
+@pytest.mark.slow
+def test_two_process_import_covers_all_lines(tmp_path):
+    """`pio launch -- import`: each process inserts its 1/N of the lines
+    into the shared store (the reference's FileToEvents Spark-job role);
+    the union is exact and idempotent (events carry eventIds)."""
+    import json as jsonlib
+
+    env = sqlite_env(tmp_path)
+    app_id = int(run_py(
+        tmp_path, env, """
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.data.storage.base import App
+st = Storage.instance()
+app_id = st.get_meta_data_apps().insert(App(0, "impapp"))
+st.get_l_events().init(app_id)
+print(app_id)
+""",
+    ).strip().splitlines()[-1])
+    lines = tmp_path / "events_in.jsonl"
+    lines.write_text(
+        "".join(
+            jsonlib.dumps({
+                "eventId": f"ev{i}", "event": "rate", "entityType": "user",
+                "entityId": f"u{i % 7}", "targetEntityType": "item",
+                "targetEntityId": f"i{i % 5}",
+                "properties": {"rating": 3.0},
+                "eventTime": "2026-01-01T00:00:00.000Z",
+            }) + "\n"
+            for i in range(50)
+        )
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "predictionio_tpu.tools.cli", "launch",
+            "-n", "2", "--coordinator-port", str(free_port()), "--",
+            "import", "--appid", str(app_id), "--input", str(lines),
+        ],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    # both workers imported a proper share
+    counts = sorted(
+        int(m) for m in __import__("re").findall(
+            r"Imported (\d+) events", r.stdout
+        )
+    )
+    assert counts == [25, 25], r.stdout
+    out = run_py(
+        tmp_path, env, f"""
+from predictionio_tpu.data.storage.registry import Storage
+evs = Storage.instance().get_l_events().find({app_id})
+ids = sorted(e.event_id for e in evs)
+assert len(ids) == 50 and len(set(ids)) == 50, len(ids)
+print("IMPORT-COVERED", len(ids))
+""",
+    )
+    assert "IMPORT-COVERED 50" in out
