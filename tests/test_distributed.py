@@ -154,13 +154,19 @@ print("OK one completed instance", completed[0].id)
     assert "OK one completed instance" in out
 
 
-WORKER = """
+# shared worker-subprocess preamble: 2 virtual CPU devices per process,
+# platform pinned at the config level (the env var alone doesn't stick on
+# this image — see tests/conftest.py)
+WORKER_PREAMBLE = f"""
 import os, sys
-sys.path.insert(0, {repo!r})
+sys.path.insert(0, {REPO!r})
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 import jax
 jax.config.update("jax_platforms", "cpu")
+"""
+
+WORKER = WORKER_PREAMBLE + """
 from functools import partial
 import numpy as np
 import jax.numpy as jnp
@@ -179,14 +185,14 @@ def total(b):
     return jax.lax.psum(jnp.sum(b, keepdims=True), "data")
 
 result = float(np.asarray(jax.device_get(total(x)))[0])
-print(f"RESULT {{distributed.process_index()}} {{n}} {{result}}")
+print(f"RESULT {distributed.process_index()} {n} {result}")
 """
 
 
 @pytest.mark.slow
 def test_two_process_mesh_psum(tmp_path):
     script = tmp_path / "worker.py"
-    script.write_text(WORKER.format(repo=REPO))
+    script.write_text(WORKER)
     outs = run_worker_pair(script)
     for out in outs:
         line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
@@ -720,13 +726,7 @@ def test_two_process_host_sum_slabbed(tmp_path):
     memory) under REAL multi-process execution."""
     script = tmp_path / "worker.py"
     script.write_text(
-        f"""
-import os, sys
-sys.path.insert(0, {REPO!r})
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-import jax
-jax.config.update("jax_platforms", "cpu")
+        WORKER_PREAMBLE + """
 import numpy as np
 from predictionio_tpu.parallel import distributed
 
@@ -900,3 +900,32 @@ print("IMPORT-COVERED", len(ids))
 """,
     )
     assert "IMPORT-COVERED 50" in out
+
+
+@pytest.mark.slow
+def test_two_process_ring_attention_matches_full(tmp_path):
+    """Ring attention with the sequence sharded ACROSS the process boundary:
+    the ppermute ring rides the cross-process transport (the DCN path on a
+    real pod) and must still equal dense attention exactly."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        WORKER_PREAMBLE + """
+import numpy as np
+from predictionio_tpu.parallel import distributed
+from predictionio_tpu.parallel.mesh import MeshContext, device_get_global
+from predictionio_tpu.parallel.ring import full_attention, ring_attention
+
+assert distributed.initialize()
+ctx = MeshContext.create()  # 4 global devices: 2 procs x 2
+rng = np.random.default_rng(0)
+q, k, v = (rng.normal(size=(32, 8)).astype(np.float32) for _ in range(3))
+for causal in (False, True):
+    # the result spans both processes; gather it (a collective) to compare
+    out = device_get_global(ring_attention(ctx, q, k, v, causal=causal))
+    ref = np.asarray(full_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+print("RING OK", distributed.process_index())
+"""
+    )
+    for out in run_worker_pair(script):
+        assert "RING OK" in out
