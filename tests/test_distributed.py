@@ -904,9 +904,10 @@ print("IMPORT-COVERED", len(ids))
 
 @pytest.mark.slow
 def test_two_process_ring_attention_matches_full(tmp_path):
-    """Ring attention with the sequence sharded ACROSS the process boundary:
-    the ppermute ring rides the cross-process transport (the DCN path on a
-    real pod) and must still equal dense attention exactly."""
+    """Both sequence-parallel strategies with the sequence sharded ACROSS
+    the process boundary: the ppermute ring and Ulysses' two all_to_all
+    hops ride the cross-process transport (the DCN path on a real pod)
+    and must still equal dense attention exactly."""
     script = tmp_path / "worker.py"
     script.write_text(
         WORKER_PREAMBLE + """
@@ -925,7 +926,17 @@ for causal in (False, True):
     ref = np.asarray(full_attention(q, k, v, causal=causal))
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 print("RING OK", distributed.process_index())
+
+# Ulysses: BOTH all_to_all hops cross the process boundary too
+from predictionio_tpu.parallel.ulysses import ulysses_attention
+
+qh, kh, vh = (rng.normal(size=(4, 32, 8)).astype(np.float32) for _ in range(3))
+for causal in (False, True):
+    out = device_get_global(ulysses_attention(ctx, qh, kh, vh, causal=causal))
+    ref = np.asarray(full_attention(qh, kh, vh, causal=causal))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+print("ULYSSES OK", distributed.process_index())
 """
     )
     for out in run_worker_pair(script):
-        assert "RING OK" in out
+        assert "RING OK" in out and "ULYSSES OK" in out
