@@ -940,3 +940,98 @@ print("ULYSSES OK", distributed.process_index())
     )
     for out in run_worker_pair(script):
         assert "RING OK" in out and "ULYSSES OK" in out
+
+
+@pytest.mark.slow
+def test_two_process_train_over_network_storage(tmp_path):
+    """The no-shared-filesystem production topology: a storage server owns
+    the data, BOTH launch processes dial it with the network driver —
+    sharded ingest pushes the 1/N predicate to the server, the id-table
+    exchange rendezvouses through the remote model repo, and exactly one
+    COMPLETED instance lands."""
+    # the storage server runs in its own subprocess backed by sqlite
+    srv_env = dict(os.environ)
+    srv_env.update(
+        {
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "server.sqlite"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        }
+    )
+    sport = free_port()
+    srv = subprocess.Popen(
+        [
+            sys.executable, "-m", "predictionio_tpu.tools.cli",
+            "storageserver", "--ip", "127.0.0.1", "--port", str(sport),
+        ],
+        env=srv_env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        import time
+        import urllib.request
+
+        deadline = time.time() + 60  # cold jax import can be slow on CI
+        while True:
+            if srv.poll() is not None:
+                out, _ = srv.communicate()
+                raise AssertionError(f"storage server died: {out[-3000:]}")
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{sport}/", timeout=1
+                ).read()
+                break
+            except Exception:
+                if time.time() > deadline:
+                    raise AssertionError(
+                        "storage server never came up"
+                    ) from None
+                time.sleep(0.1)
+
+        env = dict(os.environ)
+        env.update(
+            {
+                "PYTHONPATH": REPO,
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "PIO_STORAGE_SOURCES_NET_TYPE": "network",
+                "PIO_STORAGE_SOURCES_NET_URL": f"http://127.0.0.1:{sport}",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+                "PIO_BASE_DIR": str(tmp_path / "base"),
+            }
+        )
+        seed_ratings(tmp_path, env, "netapp")
+        write_engine_json(tmp_path, "netapp", {"rank": 3, "numIterations": 2})
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "predictionio_tpu.tools.cli", "launch",
+                "-n", "2", "--coordinator-port", str(free_port()),
+                "--", "--verbose", "train",
+            ],
+            env=env, cwd=str(tmp_path), capture_output=True, text=True,
+            timeout=300,
+        )
+        assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+        import re
+
+        scans = {
+            int(p): int(c)
+            for p, c in re.findall(
+                r"sharded ingest p(\d)/2: (\d+) user-pass", r.stdout
+            )
+        }
+        # both processes read a PROPER slice and the slices cover the store
+        assert set(scans) == {0, 1}, r.stdout
+        assert scans[0] + scans[1] == 120 and all(
+            0 < c < 120 for c in scans.values()
+        )
+        assert_one_completed(tmp_path, env)
+    finally:
+        srv.kill()
+        srv.communicate()
