@@ -455,3 +455,24 @@ class TestServerInfo:
             info = json.loads(r.read().decode())
         assert info["service"] == "pio-storage-server"
         assert info["repositories"]["EVENTDATA"]["type"] == "memory"
+
+
+class TestPreShardingServer:
+    def test_sharded_scan_fails_loudly_not_silently_full(self, served, monkeypatch):
+        """A pre-sharding backing DAO must 400 a sharded scan: silently
+        returning the FULL result to every worker would duplicate every
+        rating N times in a multi-host train."""
+        backing_pe = served["backing"].get_p_events()
+        orig = backing_pe.find
+
+        def legacy_find(app_id, channel_id=None, **kw):
+            if "shard" in kw or "shard_key" in kw:
+                raise TypeError("find() got an unexpected keyword 'shard'")
+            return orig(app_id, channel_id=channel_id, **kw)
+
+        monkeypatch.setattr(backing_pe, "find", legacy_find)
+        pe = served["client"].get_p_events()
+        # unsharded scans still work against the legacy server
+        assert len(pe.find(1)) == 0
+        with pytest.raises(NetworkStorageError):
+            pe.find(1, shard=(0, 2), shard_key="entity")
