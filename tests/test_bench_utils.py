@@ -1,0 +1,54 @@
+"""bench.py helper sanity: the artifact math the driver records per round."""
+
+import numpy as np
+
+import bench
+
+
+class TestUtilizationModel:
+    def test_scales_and_reports_peaks_only_on_tpu(self):
+        base = bench._utilization(
+            n_ratings=1_000_000, n_users=50_000, n_items=10_000, rank=10,
+            iterations=3, dtype="f32", dt=10.0, n_chips=1, platform="tpu",
+        )
+        assert base["model_flops_per_sec_per_chip"] > 0
+        assert base["model_hbm_gbps_per_chip"] > 0
+        assert 0 < base["mfu"] < 1 and 0 < base["hbm_util"] < 1
+        # double the ratings at fixed wall time → ~double the throughput
+        double = bench._utilization(
+            n_ratings=2_000_000, n_users=50_000, n_items=10_000, rank=10,
+            iterations=3, dtype="f32", dt=10.0, n_chips=1, platform="tpu",
+        )
+        ratio = (
+            double["model_flops_per_sec_per_chip"]
+            / base["model_flops_per_sec_per_chip"]
+        )
+        assert 1.9 < ratio < 2.0  # entity terms keep it just under 2x
+        # unknown platforms must NOT report utilization against wrong peaks
+        cpu = bench._utilization(
+            n_ratings=1_000_000, n_users=50_000, n_items=10_000, rank=10,
+            iterations=3, dtype="f32", dt=10.0, n_chips=1, platform="cpu",
+        )
+        assert cpu["mfu"] is None and cpu["hbm_util"] is None
+
+    def test_bf16_halves_gather_traffic(self):
+        f32 = bench._utilization(
+            1_000_000, 50_000, 10_000, 10, 3, "f32", 10.0, 1, "tpu"
+        )
+        bf16 = bench._utilization(
+            1_000_000, 50_000, 10_000, 10, 3, "bf16", 10.0, 1, "tpu"
+        )
+        assert bf16["model_hbm_gbps_per_chip"] < f32["model_hbm_gbps_per_chip"]
+
+
+class TestSampleIds:
+    def test_distributions_cover_range(self):
+        rng = np.random.default_rng(0)
+        for dist in ("uniform", "zipf"):
+            ids = bench._sample_ids(rng, 1000, 50_000, dist, s=1.1)
+            assert ids.min() >= 0 and ids.max() < 1000
+        # zipf concentrates mass on low ids far beyond uniform
+        rng = np.random.default_rng(0)
+        z = bench._sample_ids(rng, 1000, 100_000, "zipf", s=1.1)
+        u = bench._sample_ids(rng, 1000, 100_000, "uniform", s=1.1)
+        assert (z < 50).mean() > 2 * (u < 50).mean()
