@@ -30,6 +30,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import threading
 from functools import partial
 from typing import Optional
 
@@ -82,10 +83,14 @@ class ALSConfig:
     #   "segment" — rating-stream segment_sum (scatter-add) accumulation;
     #               the strict fallback (the native.py discipline) and the
     #               reference-shaped formulation.
-    # PIO_ALS_SOLVER overrides the default for benchmarking A/B.
-    solver: str = os.environ.get("PIO_ALS_SOLVER", "dense")
+    # PIO_ALS_SOLVER overrides the default for benchmarking A/B.  Resolved
+    # at CONSTRUCTION time (None → env), not import time, so an in-process
+    # sweep toggling the env var between configs takes effect.
+    solver: Optional[str] = None
 
     def __post_init__(self):
+        if self.solver is None:
+            self.solver = os.environ.get("PIO_ALS_SOLVER", "dense")
         if self.compute_dtype not in ("f32", "bf16"):
             raise ValueError(
                 f"compute_dtype must be 'f32' or 'bf16', got {self.compute_dtype!r}"
@@ -1025,6 +1030,10 @@ class ALSScorer:
     # the host path.
     FILTER_BUCKETS = (0, 64, 512, 4096, 32768)
 
+    # guards lazy _score_batch creation: concurrent eval/serving threads
+    # racing the check-then-set would each trace+compile their own copy
+    _batch_init_lock = threading.Lock()
+
     def __init__(
         self,
         ctx: MeshContext,
@@ -1087,14 +1096,18 @@ class ALSScorer:
         k = min(max(num, 1), self.n_items)
         if self.on_device and k <= self._k:
             if not hasattr(self, "_score_batch"):
+                with self._batch_init_lock:
+                    if not hasattr(self, "_score_batch"):
 
-                @jax.jit
-                def _score_batch(U, V, pad_mask, u_idx):
-                    scores = U[u_idx] @ V.T  # (B, pad)
-                    scores = jnp.where(pad_mask[None, :], -1e30, scores)
-                    return jax.lax.top_k(scores, self._k)
+                        @jax.jit
+                        def _score_batch(U, V, pad_mask, u_idx):
+                            scores = U[u_idx] @ V.T  # (B, pad)
+                            scores = jnp.where(
+                                pad_mask[None, :], -1e30, scores
+                            )
+                            return jax.lax.top_k(scores, self._k)
 
-                self._score_batch = _score_batch
+                        self._score_batch = _score_batch
             vals, idx = self._score_batch(
                 self._U, self._V, self._pad_mask, jnp.asarray(users)
             )

@@ -22,6 +22,7 @@ exchange collectives.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import partial
 from typing import Optional
 
@@ -41,6 +42,8 @@ from predictionio_tpu.parallel.mesh import (
 from predictionio_tpu.parallel.ring import full_attention
 
 PAD = 0  # item ids are shifted by +1; 0 is the padding token
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)  # hashable: passed as a static jit arg
@@ -370,7 +373,14 @@ def train_sasrec(
     a multi-host launch each host holds only ITS users' complete event
     histories (1/N ingest, entity-keyed), builds only their sequences, and
     contributes its slice of every global batch (pure data parallelism:
-    XLA all-reduces the gradients)."""
+    XLA all-reduces the gradients).
+
+    Sampling note (sharded): each host draws its ``batch/n_hosts`` rows
+    uniformly from its OWN users, so a user on a lightly-populated shard
+    is sampled more often than under the single-host uniform stream; the
+    crc32 entity-hash sharding keeps shard sizes close enough that the
+    deviation is second-order. A host whose shard has no trainable user
+    contributes all-PAD rows rather than aborting the launch."""
     from predictionio_tpu.parallel.ingest import ShardedInteractions
 
     cfg = config or SASRecConfig()
@@ -395,24 +405,35 @@ def train_sasrec(
     keep = (seqs != PAD).sum(1) >= 2
     seqs = seqs[keep]
     n = len(seqs)
+    # the GLOBAL trainable-user count (from the exchanged degree vector,
+    # identical on every host) decides both training viability and the
+    # batch shape — never this host's local n, which may be zero or
+    # unbalanced
+    n_global = (
+        int((interactions.user_counts >= 2).sum()) if sharded else n
+    )
     if n == 0:
-        raise ValueError(
-            "no user has >= 2 interaction events; sequential training needs "
-            "at least one (previous item -> next item) transition"
-            + (f" (host {interactions.process_index})" if sharded else "")
+        # A host whose crc32 user shard happens to contain no trainable
+        # user must NOT kill a globally-viable launch: it contributes
+        # all-PAD rows (zero valid targets — the masked loss ignores them)
+        # so every collective still sees an identically-shaped batch.
+        if n_global == 0:
+            raise ValueError(
+                "no user has >= 2 interaction events; sequential training "
+                "needs at least one (previous item -> next item) transition"
+            )
+        log.warning(
+            "host %d: local user shard has no trainable sequence; "
+            "contributing all-PAD batch slices",
+            interactions.process_index,
         )
+        seqs = np.full((1, cfg.max_len + 1), PAD, seqs.dtype)
+        n = 1
     n_shards = ctx.axis_size(DATA_AXIS)
     if sharded and n_shards % n_hosts:
         raise ValueError(
             f"{n_shards} device shards not divisible by {n_hosts} hosts"
         )
-    # the batch shape must be identical on every host: derive it from the
-    # GLOBAL trainable-user count (the exchanged degree vector), never from
-    # this host's local n — unbalanced shards would otherwise assemble
-    # mismatched "global" arrays
-    n_global = (
-        int((interactions.user_counts >= 2).sum()) if sharded else n
-    )
     batch = min(cfg.batch_size, pad_to_multiple(n_global, n_shards))
     batch = pad_to_multiple(batch, n_shards)
 

@@ -114,16 +114,19 @@ def host_sum(x):
         return x
     from jax.experimental import multihost_utils
 
-    if x.ndim < 2 or x.size <= _HOST_SUM_SLAB_ELEMS:
+    if x.size <= _HOST_SUM_SLAB_ELEMS:
         return np.asarray(multihost_utils.process_allgather(x)).sum(axis=0)
-    rows_per_slab = max(1, _HOST_SUM_SLAB_ELEMS // max(1, x[0].size))
-    out = np.empty_like(x)
-    for s in range(0, x.shape[0], rows_per_slab):
-        piece = np.ascontiguousarray(x[s : s + rows_per_slab])
-        out[s : s + rows_per_slab] = np.asarray(
+    # Slab over the FLATTENED element range regardless of rank, so a large
+    # 1-D vector (e.g. item counts for a huge catalog) — or a 2-D array
+    # with slab-sized rows — is bounded just like a tall matrix.
+    flat = np.ascontiguousarray(x).reshape(-1)
+    out = np.empty_like(flat)
+    for s in range(0, flat.size, _HOST_SUM_SLAB_ELEMS):
+        piece = np.ascontiguousarray(flat[s : s + _HOST_SUM_SLAB_ELEMS])
+        out[s : s + _HOST_SUM_SLAB_ELEMS] = np.asarray(
             multihost_utils.process_allgather(piece)
         ).sum(axis=0)
-    return out
+    return out.reshape(x.shape)
 
 
 def process_slot() -> tuple[int, int]:

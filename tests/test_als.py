@@ -446,3 +446,67 @@ class TestALSScorer:
         scorer = ALSScorer(ctx, model)
         idx, _ = scorer.recommend(0, 50)
         assert len(idx) == 4  # capped at item count, no padding leaks
+
+
+class TestSolverConfig:
+    def test_env_override_resolved_at_construction(self, monkeypatch):
+        """PIO_ALS_SOLVER must take effect for configs constructed AFTER the
+        env var changes — an in-process A/B sweep toggles it between runs
+        (previously it was read once at import time)."""
+        monkeypatch.setenv("PIO_ALS_SOLVER", "segment")
+        assert ALSConfig().solver == "segment"
+        monkeypatch.setenv("PIO_ALS_SOLVER", "dense")
+        assert ALSConfig().solver == "dense"
+        monkeypatch.delenv("PIO_ALS_SOLVER")
+        assert ALSConfig().solver == "dense"
+        # explicit argument always wins over the env var
+        monkeypatch.setenv("PIO_ALS_SOLVER", "segment")
+        assert ALSConfig(solver="dense").solver == "dense"
+
+    def test_invalid_solver_rejected(self, monkeypatch):
+        monkeypatch.setenv("PIO_ALS_SOLVER", "magic")
+        with pytest.raises(ValueError, match="solver"):
+            ALSConfig()
+
+
+class TestScorerBatchCompileLock:
+    def test_concurrent_recommend_batch_single_compile(self, ctx):
+        """Concurrent first calls must share ONE lazily-built _score_batch
+        (double-checked lock), not race the setattr and trace twice."""
+        import threading
+
+        inter = synthetic_explicit(n_users=8, n_items=12)
+        model = train_als(ctx, inter, ALSConfig(rank=2, iterations=2))
+        scorer = ALSScorer(ctx, model, on_device=True)
+        built = []
+        orig_lock = ALSScorer._batch_init_lock
+
+        class SpyLock:
+            def __enter__(self):
+                orig_lock.acquire()
+                built.append(getattr(scorer, "_score_batch", None))
+                return self
+
+            def __exit__(self, *a):
+                orig_lock.release()
+
+        scorer._batch_init_lock = SpyLock()
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(
+                    scorer.recommend_batch(np.arange(4), 3)
+                )
+            )
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 4
+        # every thread that entered the critical section after the first
+        # saw the already-built fn (double check held) — at most one None
+        assert sum(b is None for b in built) <= 1
+        for idx, _ in results:
+            assert idx.shape == (4, 3)

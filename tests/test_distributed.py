@@ -739,6 +739,10 @@ np.testing.assert_allclose(whole, want)
 distributed._HOST_SUM_SLAB_ELEMS = 10  # force ~2-row slabs
 slabbed = distributed.host_sum(x)
 np.testing.assert_allclose(slabbed, want)
+# 1-D arrays must slab by element range too (a large vector previously
+# bypassed the bound entirely)
+v = np.arange(37, dtype=np.float64) * (pid + 1)
+np.testing.assert_allclose(distributed.host_sum(v), v / (pid + 1) * 3)
 print("HOSTSUM OK", pid)
 """
     )
