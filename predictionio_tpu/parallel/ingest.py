@@ -18,19 +18,24 @@ Two read passes per host (2/N of the rows total):
 * **item pass** (``shard_key="target"``): the same keyed by item — the
   item-side half-step's rows.
 
-The merged (sorted-string) union of the per-host tables gives every host
-an IDENTICAL global BiMap + degree vector, so downstream relabeling (LPT
-permutations, degree buckets) is deterministic across hosts with no
-further communication.
+The hash-partitioned rendezvous (:func:`exchange_entity_tables`) gives
+every host an IDENTICAL global BiMap + degree vector: entities are
+scattered to an owner by ``crc32(entity) % N``, each owner sorts and
+republishes its 1/N slice, and global ids are assigned partition-major
+(owner's slice offset + rank within the bytes-sorted slice). The order is
+deterministic everywhere — but NOT lexicographic over the union — so
+downstream relabeling (LPT permutations, degree buckets) needs no further
+communication.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import json
+import io
 import logging
 import time
-from typing import Optional
+import zlib
+from typing import Optional, Union
 
 import numpy as np
 
@@ -84,68 +89,211 @@ class ShardedInteractions:
         return int(self.user_counts.sum())
 
 
+def _encode_cols(names: np.ndarray, counts: np.ndarray, digest: int) -> bytes:
+    """Binary columnar table blob: fixed-width UTF-8 names + int64 counts.
+
+    ~10× smaller than the former per-entity JSON dict and decoded as two
+    array reads instead of O(entities) parse work — the wire format of
+    the rendezvous (npz, the same container ``network.py`` frames).
+    """
+    bio = io.BytesIO()
+    np.savez(
+        bio, names=names, counts=np.asarray(counts, np.int64),
+        digest=np.int64(digest),
+    )
+    return bio.getvalue()
+
+
+def _decode_cols(buf: bytes) -> tuple[np.ndarray, np.ndarray, int]:
+    with np.load(io.BytesIO(buf), allow_pickle=False) as z:
+        return z["names"], z["counts"], int(z["digest"])
+
+
+def _poll_get(models, blob_id: str, deadline: float, poll: float, what: str):
+    while True:
+        m = models.get(blob_id)
+        if m is not None:
+            return m.models
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"shard-map exchange: {what} never appeared (worker dead "
+                "or storage not shared across hosts?)"
+            )
+        time.sleep(poll)
+
+
+def _reject_trailing_nul(keys) -> None:
+    # fixed-width numpy string arrays cannot represent a trailing NUL
+    # (numpy strips it), which would silently merge 'x' and 'x\0' into one
+    # global id — fail loudly instead of corrupting the vocab
+    if any(s.endswith("\0") for s in keys):
+        raise ValueError(
+            "entity ids ending in a NUL byte cannot ride the columnar "
+            "vocab exchange (numpy fixed-width strings drop trailing NULs)"
+        )
+
+
+def _to_name_count_arrays(
+    local_counts: Union[dict, tuple],
+) -> tuple[np.ndarray, np.ndarray]:
+    """Accept a (entity → count) dict or a ``(names, counts)`` array pair;
+    return UTF-8 byte names + int64 counts. Array-pair names may be any
+    string dtype (object arrays — e.g. ``pd.factorize`` output — are
+    coerced); trailing-NUL ids are rejected loudly (see
+    :func:`_reject_trailing_nul`; an array pair built with a 'U' dtype has
+    already lost them to numpy's own stripping)."""
+    if isinstance(local_counts, dict):
+        _reject_trailing_nul(local_counts)
+        names = np.array(list(local_counts), dtype="U") if local_counts \
+            else np.empty(0, "U1")
+        counts = np.fromiter(
+            local_counts.values(), np.int64, len(local_counts)
+        )
+    else:
+        names, counts = local_counts
+        names = np.asarray(names)
+        counts = np.asarray(counts, np.int64)
+        if names.dtype.kind == "O":
+            _reject_trailing_nul(names.tolist())
+            names = names.astype("U")
+    if names.dtype.kind == "U":
+        names = (
+            np.char.encode(names, "utf-8")
+            if len(names) else np.empty(0, "S1")
+        )
+    return names, counts
+
+
 def exchange_entity_tables(
     storage,
     key: str,
-    local_counts: dict,
+    local_counts: Union[dict, tuple],
     process_index: int,
     num_processes: int,
     timeout: float = 300.0,
     poll: float = 0.2,
     local_digest: int = 0,
 ) -> tuple[BiMap, np.ndarray, int]:
-    """Publish this host's (entity → count) table; return the global merge.
+    """Hash-partitioned vocab rendezvous; returns the global merge.
 
-    Every host inserts ``__pio_shardmap__<key>_<p>`` into the model-data
-    repository and polls until all N tables are present. Global ids are
-    ranks in sorted string order of the union — identical everywhere.
+    SURVEY §7 "BiMap at scale": no host ever publishes, fetches, or SORTS
+    more than O(entities/N) strings per blob. Three phases through the
+    model-data repository (the storage layer is the control plane, the
+    role the Spark driver's collect plays — parity
+    ``JDBCPEvents.scala:35-119`` partitioned reads):
+
+    1. **scatter** — host ``p`` splits its local (entity → count) table by
+       ``crc32(entity) % N`` (the DAO ``shard_hash`` contract, so the
+       pass-keyed entities land on their OWN host's bucket and cross
+       traffic is only the opposite-side tables) and publishes one binary
+       column blob per destination partition.
+    2. **merge** — host ``q`` collects the N buckets of ITS partition,
+       sums duplicate counts, sorts its 1/N slice once, and republishes it
+       with the partition's digest total.
+    3. **assemble** — every host concatenates the N pre-sorted slices
+       partition-major; global id = slice offset + rank within slice.
+       Identical on every host, no global sort anywhere.
+
     ``key`` MUST be launch-scoped (``pio launch`` exports a fresh
     PIO_RUN_ID per invocation; when re-running ``--hosts`` rendered
     commands, regenerate the id) so a crashed earlier run's blobs can
     never be merged into a fresh run. ``local_digest`` rides along and
     returns summed (mod 2⁴⁸) — a host-independent digest of the actual
-    rows for checkpoint fingerprints.
+    rows for checkpoint fingerprints. ``local_counts`` may be a dict or a
+    ``(names, counts)`` array pair (the array form skips building an
+    O(entities) Python dict on the publish side).
     """
     models = storage.get_model_data_models()
-    blob = json.dumps(
-        {"counts": local_counts, "digest": int(local_digest)}
-    ).encode()
-    models.insert(
-        storage_base.Model(f"{_BLOB_PREFIX}{key}_{process_index}", blob)
+    names, counts = _to_name_count_arrays(local_counts)
+    # crc32 over the UTF-8 bytes ≡ PEvents.shard_hash (base.py:263-271) on
+    # the decoded string — the SAME assignment as the DAO shard pushdown,
+    # so a pass-keyed entity's bucket is its own host (pinned by
+    # test_partition_function_matches_dao_shard_hash)
+    part = (
+        np.fromiter(
+            (zlib.crc32(b) % num_processes for b in names.tolist()),
+            np.int64, len(names),
+        )
+        if len(names)
+        else np.empty(0, np.int64)
     )
-    merged: dict = {}
-    digest = 0
     deadline = time.monotonic() + timeout
-    for p in range(num_processes):
-        while True:
-            m = models.get(f"{_BLOB_PREFIX}{key}_{p}")
-            if m is not None:
-                break
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"shard-map exchange: table {p}/{num_processes} for "
-                    f"{key!r} never appeared (worker dead or storage not "
-                    "shared across hosts?)"
-                )
-            time.sleep(poll)
-        table = json.loads(m.models.decode())
-        for s, c in table["counts"].items():
-            merged[s] = merged.get(s, 0) + int(c)
-        digest = (digest + int(table.get("digest", 0))) % (1 << 48)
-    names = sorted(merged)
-    bimap = BiMap({s: i for i, s in enumerate(names)})
-    counts = np.array([merged[s] for s in names], dtype=np.int64)
-    return bimap, counts, digest
+    # 1. scatter: one bucket per destination partition
+    for q in range(num_processes):
+        m = part == q
+        models.insert(
+            storage_base.Model(
+                f"{_BLOB_PREFIX}{key}_s{process_index}to{q}",
+                _encode_cols(names[m], counts[m], local_digest),
+            )
+        )
+    # 2. merge MY partition's buckets (1/N of the global vocab)
+    q = process_index
+    bufs = [
+        _decode_cols(
+            _poll_get(
+                models, f"{_BLOB_PREFIX}{key}_s{p}to{q}", deadline, poll,
+                f"bucket {p}→{q}/{num_processes} for {key!r}",
+            )
+        )
+        for p in range(num_processes)
+    ]
+    digest = sum(b[2] for b in bufs) % (1 << 48)
+    nm = [b[0] for b in bufs if len(b[0])]
+    if nm:
+        width = max(a.dtype.itemsize for a in nm)
+        cat = np.concatenate([a.astype(f"S{width}") for a in nm])
+        cnt = np.concatenate([b[1] for b in bufs if len(b[0])])
+        uniq, inv = np.unique(cat, return_inverse=True)
+        slice_counts = np.zeros(len(uniq), np.int64)
+        np.add.at(slice_counts, inv, cnt)
+    else:
+        uniq = np.empty(0, "S1")
+        slice_counts = np.empty(0, np.int64)
+    models.insert(
+        storage_base.Model(
+            f"{_BLOB_PREFIX}{key}_m{q}",
+            _encode_cols(uniq, slice_counts, digest),
+        )
+    )
+    # 3. assemble: pre-sorted slices concatenate partition-major
+    fwd: dict = {}
+    count_parts = []
+    total_digest = 0
+    offset = 0
+    for r in range(num_processes):
+        snames, scounts, sdigest = _decode_cols(
+            _poll_get(
+                models, f"{_BLOB_PREFIX}{key}_m{r}", deadline, poll,
+                f"merged slice {r}/{num_processes} for {key!r}",
+            )
+        )
+        if r == 0:
+            # every owner computed the same Σ per-host digest; read one
+            total_digest = sdigest
+        dec = np.char.decode(snames, "utf-8") if len(snames) else snames
+        fwd.update(zip(dec.tolist(), range(offset, offset + len(dec))))
+        offset += len(dec)
+        count_parts.append(scounts)
+    bimap = BiMap(fwd)
+    counts_vec = (
+        np.concatenate(count_parts) if count_parts else np.empty(0, np.int64)
+    )
+    return bimap, counts_vec, total_digest
 
 
 def cleanup_exchange(storage, key: str, num_processes: int) -> None:
     """Best-effort removal of one exchange's blobs."""
     models = storage.get_model_data_models()
     for p in range(num_processes):
-        try:
-            models.delete(f"{_BLOB_PREFIX}{key}_{p}")
-        except Exception:  # pragma: no cover - cleanup must never fail a run
-            pass
+        ids = [f"{_BLOB_PREFIX}{key}_m{p}"] + [
+            f"{_BLOB_PREFIX}{key}_s{p}to{q}" for q in range(num_processes)
+        ]
+        for blob_id in ids:
+            try:
+                models.delete(blob_id)
+            except Exception:  # pragma: no cover - cleanup must never fail
+                pass
 
 
 def cleanup_exchange_keys(storage, run_key: str, num_processes: int) -> None:
@@ -181,10 +329,16 @@ def _translate(inter: Interactions, user_map: BiMap, item_map: BiMap):
     )
 
 
-def _count_table(codes: np.ndarray, id_map: BiMap) -> dict:
+def _count_table(
+    codes: np.ndarray, id_map: BiMap
+) -> tuple[np.ndarray, np.ndarray]:
+    """(names, counts) column pair for the exchange — no per-entity dict."""
     counts = np.bincount(codes, minlength=len(id_map))
     inv = id_map.inverse
-    return {inv[i]: int(c) for i, c in enumerate(counts)}
+    name_list = [inv[i] for i in range(len(id_map))]
+    _reject_trailing_nul(name_list)
+    names = np.array(name_list, dtype="U")
+    return names, counts.astype(np.int64)
 
 
 def template_interactions(

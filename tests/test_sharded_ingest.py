@@ -78,15 +78,148 @@ class TestExchange:
             m0, c0, d0 = f0.result(30)
             m1, c1, d1 = f1.result(30)
         assert d0 == d1 == 18  # per-host digests sum host-independently
-        assert {i: m0.inverse[i] for i in range(3)} == {0: "a", 1: "b", 2: "c"}
-        assert {i: m1.inverse[i] for i in range(3)} == {0: "a", 1: "b", 2: "c"}
-        assert list(c0) == list(c1) == [3, 1, 5]
+        # identical global maps, contiguous ids, counts aligned with ids
+        assert m0.inverse == m1.inverse
+        assert set(m0.keys()) == {"a", "b", "c"}
+        assert sorted(m0[s] for s in "abc") == [0, 1, 2]
+        want = {"a": 3, "b": 1, "c": 5}
+        assert {s: int(c0[m0[s]]) for s in "abc"} == want
+        assert list(c0) == list(c1)
 
     def test_missing_worker_times_out_loudly(self, storage):
         with pytest.raises(TimeoutError, match="never appeared"):
             exchange_entity_tables(
                 storage, "k2", {"a": 1}, 0, 2, timeout=0.5, poll=0.05
             )
+
+    def test_array_pair_input_matches_dict_input(self, storage):
+        """The (names, counts) array form (what _count_table now emits)
+        must produce the identical merge as the dict form."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        names = np.array(["x", "y", "z"])
+        counts = np.array([4, 2, 9])
+        with ThreadPoolExecutor(2) as ex:
+            f0 = ex.submit(
+                exchange_entity_tables, storage, "ka", (names, counts), 0, 2
+            )
+            f1 = ex.submit(
+                exchange_entity_tables, storage, "ka",
+                {"y": 1, "w": 7}, 1, 2,
+            )
+            m0, c0, _ = f0.result(30)
+            m1, c1, _ = f1.result(30)
+        assert m0.inverse == m1.inverse
+        assert {s: int(c0[m0[s]]) for s in "xyzw"} == {
+            "x": 4, "y": 3, "z": 9, "w": 7,
+        }
+
+    def test_partition_function_matches_dao_shard_hash(self):
+        """The scatter bucket of every entity must equal its DAO shard
+        (PEvents.shard_hash) — that identity is what makes the pass-keyed
+        scatter self-addressed. If shard_hash ever changes, this must
+        fail rather than silently degrade to cross-host traffic."""
+        import zlib
+
+        from predictionio_tpu.data.storage.base import PEvents
+        from predictionio_tpu.parallel.ingest import _to_name_count_arrays
+
+        samples = ["u1", "item-42", "日本語", "x" * 300, ""]
+        names, _ = _to_name_count_arrays(
+            {s: 1 for s in samples if s} | {"": 1}
+        )
+        for b, s in zip(names.tolist(), samples):
+            assert zlib.crc32(b) == PEvents.shard_hash(s), s
+
+    def test_trailing_nul_ids_rejected_loudly(self, storage):
+        """numpy fixed-width strings drop trailing NULs; the exchange must
+        refuse such ids rather than silently merge 'x' and 'x\\0'."""
+        with pytest.raises(ValueError, match="NUL"):
+            exchange_entity_tables(storage, "kn", {"x": 1, "x\0": 2}, 0, 1)
+
+    def test_object_dtype_names_coerced(self, storage):
+        """pd.factorize-style object arrays must work as array-pair input."""
+        names = np.array(["p", "q"], dtype=object)
+        m, c, _ = exchange_entity_tables(
+            storage, "ko", (names, np.array([2, 3])), 0, 1
+        )
+        assert {s: int(c[m[s]]) for s in "pq"} == {"p": 2, "q": 3}
+
+    @pytest.mark.slow
+    def test_ten_million_entity_exchange_bounded(self, storage):
+        """SURVEY §7 "BiMap at scale" at the 10⁷-entity scale the README
+        advertises: no single rendezvous blob may carry more than ~1/N of
+        the global table (the former JSON protocol shipped each host's
+        FULL table as one blob and json-parsed all N of them per host),
+        and the whole three-phase exchange must finish in minutes, not
+        the JSON wall."""
+        import threading
+        import time as time_mod
+
+        E, N = 10_000_000, 2
+        names = np.char.add("e", np.arange(E).astype("U8"))
+        # overlapping halves: the 100k-entity overlap proves cross-host
+        # count summation at scale
+        half, ov = E // 2, 50_000
+        locals_ = [names[: half + ov], names[half - ov:]]
+
+        class RecordingModels:
+            def __init__(self, inner):
+                self.inner = inner
+                self.sizes = {}
+                self.lock = threading.Lock()
+
+            def insert(self, m):
+                with self.lock:
+                    self.sizes[m.id] = len(m.models)
+                self.inner.insert(m)
+
+            def get(self, blob_id):
+                return self.inner.get(blob_id)
+
+            def delete(self, blob_id):
+                self.inner.delete(blob_id)
+
+        rec = RecordingModels(storage.get_model_data_models())
+
+        class RecordingStorage:
+            def get_model_data_models(self):
+                return rec
+
+        t0 = time_mod.monotonic()
+        results = [None] * N
+        errs = []
+
+        def run(p):
+            try:
+                results[p] = exchange_entity_tables(
+                    RecordingStorage(), "big",
+                    (locals_[p], np.ones(len(locals_[p]), np.int64)),
+                    p, N, timeout=600.0,
+                )
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=(p,)) for p in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time_mod.monotonic() - t0
+        assert not errs, errs
+        (m0, c0, _), (m1, c1, _) = results
+        assert len(m0) == E
+        assert m0[names[0]] is not None and c0.sum() == E + 2 * ov
+        assert np.array_equal(c0, c1)
+        # per-blob payload bound: O(entities/N), NOT O(entities) — the
+        # whole point of the hash-partitioned protocol. ~17 B/entry
+        # (S9 name + int64 count) + npz framing; 1.35 gives headroom for
+        # the uneven crc32 split, not for a full-table blob (2× over).
+        per_entry = 9 + 8
+        assert max(rec.sizes.values()) < 1.35 * (E / N) * per_entry
+        # scatter + merged-slice blob census: N² + N blobs
+        assert len(rec.sizes) == N * N + N
+        assert elapsed < 300, f"exchange took {elapsed:.0f}s"
 
     def test_two_host_read_covers_everything(self, seeded):
         from concurrent.futures import ThreadPoolExecutor
@@ -206,11 +339,12 @@ class TestShardedTrain:
             storage, 1, run_key="r4", process_index=0, num_processes=1, **KW
         )
         models = storage.get_model_data_models()
-        assert models.get("__pio_shardmap__r4_user_0") is not None
+        assert models.get("__pio_shardmap__r4_user_m0") is not None
         assert sh.dataset_digest != 0
         train_als(ctx, sh, ALSConfig(rank=3, iterations=1))
         for suffix in ("user", "item", "digest"):
-            assert models.get(f"__pio_shardmap__r4_{suffix}_0") is None
+            assert models.get(f"__pio_shardmap__r4_{suffix}_m0") is None
+            assert models.get(f"__pio_shardmap__r4_{suffix}_s0to0") is None
 
     def test_sharded_requires_dense_solver(self, ctx, seeded):
         sh = read_sharded_interactions(
