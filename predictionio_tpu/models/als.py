@@ -771,30 +771,23 @@ def train_als(
     )
 
 
-def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
-    """Multi-host partitioned-ingest training (SURVEY §7 "BiMap at scale").
+def _sharded_blocks_for_host(sh, n_shards: int, pid: int, n_hosts: int):
+    """ONE host's dense blocks + layout geometry under sharded ingest.
 
-    Each host arrives with 1/N of the rows (``parallel/ingest.py``: its own
-    users' ratings + its own items' ratings, global ids, global degree
-    vectors). All relabeling and bucket geometry derive deterministically
-    from the exchanged global counts, so every host compiles the SAME
-    program and only the data differs; the factor matrices assemble from
-    process-local shards via ``jax.make_array_from_process_local_data``.
-    The only cross-host data movement is the opposite-factor all-gather
-    inside the step — XLA lays it on ICI/DCN (the Spark-shuffle role).
+    Pure host-side function of the exchanged global tables — every host
+    computes identical geometry (permutations, pads, bucket widths) and
+    only the local block CONTENTS differ. Factored out of
+    :func:`_train_als_sharded` so a single process can drive the
+    multi-host blocking path for any virtual ``(pid, n_hosts)`` (the
+    driver's ``dryrun_multichip`` concatenates per-host blocks instead of
+    ``make_array_from_process_local_data``).
+
+    Returns ``(user_blocks, item_blocks, u_geom, i_geom)`` with each geom
+    ``(per_shard, n_pad, perm, deg_blocked)``.
     """
-    if cfg.solver != "dense":
-        raise ValueError("sharded multi-host training requires solver='dense'")
     from predictionio_tpu.data.storage.base import PEvents
 
-    n_shards = ctx.axis_size(DATA_AXIS)
-    n_hosts = sh.num_processes
-    if n_shards % n_hosts:
-        raise ValueError(
-            f"{n_shards} device shards not divisible by {n_hosts} hosts"
-        )
     d_local = n_shards // n_hosts
-    pid = sh.process_index
 
     def side(id_map, counts):
         inv = id_map.inverse
@@ -814,10 +807,11 @@ def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
         deg[perm[:n]] = counts
         return per_shard, n_pad, perm, deg.reshape(n_shards, per_shard)
 
-    per_u, n_users_pad, u_perm, deg_u = side(sh.user_map, sh.user_counts)
-    per_i, n_items_pad, i_perm, deg_i = side(sh.item_map, sh.item_counts)
+    u_geom = side(sh.user_map, sh.user_counts)
+    i_geom = side(sh.item_map, sh.item_counts)
+    per_u, n_users_pad, u_perm, deg_u = u_geom
+    per_i, n_items_pad, i_perm, deg_i = i_geom
     my = (pid * d_local, (pid + 1) * d_local)
-
     ub = _make_dense_blocks(
         u_perm[sh.user_rows.user.astype(np.int64)],
         i_perm[sh.user_rows.item.astype(np.int64)],
@@ -830,6 +824,37 @@ def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
         sh.item_rows.rating.astype(np.float32),
         n_items_pad, n_shards, shard_range=my, deg_global=deg_i,
     )
+    return ub, ib, u_geom, i_geom
+
+
+def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
+    """Multi-host partitioned-ingest training (SURVEY §7 "BiMap at scale").
+
+    Each host arrives with 1/N of the rows (``parallel/ingest.py``: its own
+    users' ratings + its own items' ratings, global ids, global degree
+    vectors). All relabeling and bucket geometry derive deterministically
+    from the exchanged global counts, so every host compiles the SAME
+    program and only the data differs; the factor matrices assemble from
+    process-local shards via ``jax.make_array_from_process_local_data``.
+    The only cross-host data movement is the opposite-factor all-gather
+    inside the step — XLA lays it on ICI/DCN (the Spark-shuffle role).
+    """
+    if cfg.solver != "dense":
+        raise ValueError("sharded multi-host training requires solver='dense'")
+    n_shards = ctx.axis_size(DATA_AXIS)
+    n_hosts = sh.num_processes
+    if n_shards % n_hosts:
+        raise ValueError(
+            f"{n_shards} device shards not divisible by {n_hosts} hosts"
+        )
+    d_local = n_shards // n_hosts
+    pid = sh.process_index
+    ub, ib, u_geom, i_geom = _sharded_blocks_for_host(
+        sh, n_shards, pid, n_hosts
+    )
+    _, n_users_pad, u_perm, _ = u_geom
+    _, n_items_pad, i_perm, _ = i_geom
+    my = (pid * d_local, (pid + 1) * d_local)
 
     sh_rows = ctx.sharding(DATA_AXIS)
     sharding = ctx.sharding(DATA_AXIS, None)

@@ -126,7 +126,8 @@ def _reject_trailing_nul(keys) -> None:
     # fixed-width numpy string arrays cannot represent a trailing NUL
     # (numpy strips it), which would silently merge 'x' and 'x\0' into one
     # global id — fail loudly instead of corrupting the vocab
-    if any(s.endswith("\0") for s in keys):
+    nul = lambda s: s.endswith(b"\0" if isinstance(s, bytes) else "\0")  # noqa: E731
+    if any(nul(s) for s in keys):
         raise ValueError(
             "entity ids ending in a NUL byte cannot ride the columnar "
             "vocab exchange (numpy fixed-width strings drop trailing NULs)"
@@ -151,7 +152,12 @@ def _to_name_count_arrays(
         )
     else:
         names, counts = local_counts
-        names = np.asarray(names)
+        if not isinstance(names, np.ndarray):
+            # np.asarray of a str list strips trailing NULs BEFORE any
+            # check could see them — guard the Python values first
+            names = list(names)
+            _reject_trailing_nul(names)
+            names = np.asarray(names)
         counts = np.asarray(counts, np.int64)
         if names.dtype.kind == "O":
             _reject_trailing_nul(names.tolist())

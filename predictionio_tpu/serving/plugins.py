@@ -27,6 +27,15 @@ logger = logging.getLogger(__name__)
 ENGINE_GROUP = "predictionio_tpu.plugins"
 EVENT_GROUP = "predictionio_tpu.event_plugins"
 
+# PIO_PLUGINS lists BOTH kinds in one env var (the reference's classpath
+# is similarly kind-blind, EngineServerPluginContext.scala:34-97 +
+# EventServerPluginContext.scala); each server's discovery call keeps the
+# entries whose plugin_type belongs to its group
+_GROUP_TYPES = {
+    ENGINE_GROUP: ("outputblocker", "outputsniffer"),
+    EVENT_GROUP: ("inputblocker", "inputsniffer"),
+}
+
 
 def discover_plugins(group: str = ENGINE_GROUP) -> list:
     """Instantiate every plugin advertised for ``group``.
@@ -56,7 +65,8 @@ def discover_plugins(group: str = ENGINE_GROUP) -> list:
                 )
     except Exception:
         logger.exception("entry-point scan failed; continuing without")
-    if group == ENGINE_GROUP:
+    group_types = _GROUP_TYPES.get(group)
+    if group_types:
         from predictionio_tpu.core.persistence import resolve_class
 
         seen = {type(p) for p in out}
@@ -65,7 +75,23 @@ def discover_plugins(group: str = ENGINE_GROUP) -> list:
             if not path:
                 continue
             try:
-                plugin = resolve_class(path)()
+                cls = resolve_class(path)
+            except Exception:
+                logger.exception(
+                    "PIO_PLUGINS entry %r failed to load; skipping", path
+                )
+                continue
+            # filter on the CLASS attribute before instantiating: the
+            # other group's plugin must not run its (possibly
+            # side-effectful) __init__ in this server at all
+            if getattr(cls, "plugin_type", None) not in group_types:
+                logger.debug(
+                    "PIO_PLUGINS entry %r is not a %s plugin; skipping "
+                    "for this group", path, group,
+                )
+                continue
+            try:
+                plugin = cls()
             except Exception:
                 logger.exception(
                     "PIO_PLUGINS entry %r failed to load; skipping", path

@@ -18,6 +18,7 @@ def fake_dist(tmp_path, monkeypatch):
     site = tmp_path / "site"
     site.mkdir()
     (site / "fakeplug.py").write_text(textwrap.dedent("""
+        from predictionio_tpu.data.api.event_server import EventServerPlugin
         from predictionio_tpu.serving.query_server import EngineServerPlugin
 
         class TagBlocker(EngineServerPlugin):
@@ -32,6 +33,14 @@ def fake_dist(tmp_path, monkeypatch):
             name = "broken"
             def __init__(self):
                 raise RuntimeError("boom")
+
+        class VetoBlocker(EventServerPlugin):
+            name = "veto-blocker"
+            plugin_type = EventServerPlugin.INPUT_BLOCKER
+
+            def process(self, event_info, context):
+                if event_info["event"].get("event") == "forbidden":
+                    raise ValueError("vetoed")
     """))
     dist = site / "fakeplug-0.1.dist-info"
     dist.mkdir()
@@ -64,6 +73,75 @@ class TestDiscovery:
         )
         kinds = [type(p).__name__ for p in discover_plugins()]
         assert "EngineServerPlugin" in kinds
+
+    def test_pio_plugins_env_event_group(self, fake_dist, monkeypatch):
+        """PIO_PLUGINS covers BOTH plugin kinds (parity:
+        EventServerPluginContext.scala) — each server's discovery keeps
+        only the entries of ITS group."""
+        from predictionio_tpu.serving.plugins import (
+            EVENT_GROUP,
+            discover_plugins,
+        )
+
+        monkeypatch.setenv(
+            "PIO_PLUGINS", "fakeplug.VetoBlocker, fakeplug.TagBlocker"
+        )
+        event_names = [p.name for p in discover_plugins(EVENT_GROUP)]
+        assert event_names == ["veto-blocker"]  # the engine one filtered
+        engine_names = [p.name for p in discover_plugins()]
+        assert "tag-blocker" in engine_names
+        assert "veto-blocker" not in engine_names
+
+    def test_pio_plugins_event_blocker_rejects_on_server(
+        self, fake_dist, monkeypatch, storage
+    ):
+        """End-to-end: an event server built with no --plugin flags picks
+        the PIO_PLUGINS input blocker up and rejects what it vetoes."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        from predictionio_tpu.data import store as store_mod
+        from predictionio_tpu.data.api.event_server import EventServer
+        from predictionio_tpu.data.storage import App
+        from predictionio_tpu.tools.cli import load_plugins
+        from predictionio_tpu.serving.plugins import EVENT_GROUP
+
+        monkeypatch.setenv("PIO_PLUGINS", "fakeplug.VetoBlocker")
+        store_mod.set_storage(storage)
+        try:
+            from predictionio_tpu.data.storage import AccessKey
+
+            app_id = storage.get_meta_data_apps().insert(App(0, "vetoapp"))
+            ak = storage.get_meta_data_access_keys().insert(
+                AccessKey("", app_id, [])
+            )
+            server = EventServer(
+                storage=storage, plugins=load_plugins([], group=EVENT_GROUP)
+            )
+            port = server.start("127.0.0.1", 0)
+            try:
+                base = f"http://127.0.0.1:{port}/events.json?accessKey={ak}"
+
+                def post(event):
+                    req = urllib.request.Request(
+                        base,
+                        data=json.dumps({
+                            "event": event, "entityType": "user",
+                            "entityId": "u1",
+                        }).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    return urllib.request.urlopen(req).status
+
+                assert post("ok-event") == 201
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    post("forbidden")
+                assert ei.value.code == 403
+            finally:
+                server.stop()
+        finally:
+            store_mod.set_storage(None)
 
     def test_cli_load_plugins_dedups_explicit(self, fake_dist):
         from predictionio_tpu.tools.cli import load_plugins
