@@ -782,8 +782,11 @@ def _sharded_blocks_for_host(sh, n_shards: int, pid: int, n_hosts: int):
     driver's ``dryrun_multichip`` concatenates per-host blocks instead of
     ``make_array_from_process_local_data``).
 
-    Returns ``(user_blocks, item_blocks, u_geom, i_geom)`` with each geom
-    ``(per_shard, n_pad, perm, deg_blocked)``.
+    Returns ``(user_blocks, item_blocks, u_geom, i_geom, shard_range)``
+    with each geom ``(per_shard, n_pad, perm, deg_blocked)`` and
+    ``shard_range`` the half-open device-shard interval this host's
+    blocks (and factor rows) cover — the caller must place rows with the
+    SAME range the blocks were built with.
     """
     from predictionio_tpu.data.storage.base import PEvents
 
@@ -824,7 +827,7 @@ def _sharded_blocks_for_host(sh, n_shards: int, pid: int, n_hosts: int):
         sh.item_rows.rating.astype(np.float32),
         n_items_pad, n_shards, shard_range=my, deg_global=deg_i,
     )
-    return ub, ib, u_geom, i_geom
+    return ub, ib, u_geom, i_geom, my
 
 
 def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
@@ -847,14 +850,12 @@ def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
         raise ValueError(
             f"{n_shards} device shards not divisible by {n_hosts} hosts"
         )
-    d_local = n_shards // n_hosts
     pid = sh.process_index
-    ub, ib, u_geom, i_geom = _sharded_blocks_for_host(
+    ub, ib, u_geom, i_geom, my = _sharded_blocks_for_host(
         sh, n_shards, pid, n_hosts
     )
     _, n_users_pad, u_perm, _ = u_geom
     _, n_items_pad, i_perm, _ = i_geom
-    my = (pid * d_local, (pid + 1) * d_local)
 
     sh_rows = ctx.sharding(DATA_AXIS)
     sharding = ctx.sharding(DATA_AXIS, None)

@@ -98,7 +98,9 @@ def discover_plugins(group: str = ENGINE_GROUP) -> list:
                 )
                 continue
             # a plugin advertised BOTH ways (installed entry point + a
-            # leftover PIO_PLUGINS entry) must run once, not twice
+            # leftover PIO_PLUGINS entry) — or listed twice in the env
+            # var — must run once, not twice
             if type(plugin) not in seen:
+                seen.add(type(plugin))
                 out.append(plugin)
     return out

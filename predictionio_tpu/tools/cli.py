@@ -544,11 +544,21 @@ def cmd_run(args) -> int:
 def cmd_loadtest(args) -> int:
     from predictionio_tpu.tools.loadtest import run_loadtest
 
+    samples = {}
+    for spec in args.sample or []:
+        field, _, vals = spec.partition("=")
+        # drop empties (trailing comma) so '' never enters the rotation
+        values = [v for v in vals.split(",") if v]
+        if not field or not values:
+            print(f"[ERROR] --sample expects FIELD=v1,v2,..., got {spec!r}")
+            return 1
+        samples[field] = values
     result = run_loadtest(
         url=f"http://{args.ip}:{args.port}",
         query=json.loads(args.query),
         requests=args.requests,
         concurrency=args.concurrency,
+        samples=samples or None,
     )
     print(json.dumps(result))
     return 0 if result["errors"] == 0 else 1
@@ -736,6 +746,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--query", default='{"user": "u1", "num": 10}')
     sp.add_argument("--requests", type=int, default=200)
     sp.add_argument("--concurrency", type=int, default=8)
+    sp.add_argument(
+        "--sample", action="append", metavar="FIELD=V1,V2,...",
+        help="rotate FIELD through the listed values round-robin, one per "
+        "request (mixed-key tail latency instead of one hot payload)",
+    )
     sp.set_defaults(func=cmd_loadtest)
 
     sub.add_parser("upgrade").set_defaults(func=cmd_upgrade)

@@ -20,23 +20,38 @@ def run_loadtest(
     requests: int = 200,
     concurrency: int = 8,
     timeout: float = 30.0,
+    samples: dict = None,
 ) -> dict:
+    """``samples`` maps a query FIELD to a list of values; request ``i``
+    sends the query with ``field = values[i % len(values)]`` (round-robin,
+    deterministic). One fixed payload measures one warm jit path and one
+    hot cache line — p50 flatters; mixed keys are what tail latency
+    means. Without ``samples`` the single payload is sent verbatim."""
     latencies: list[float] = []
     errors: list[str] = []
     lock = threading.Lock()
     counter = {"next": 0}
 
-    payload = json.dumps(query).encode()
+    fixed_payload = json.dumps(query).encode()
+
+    def payload_for(i: int) -> bytes:
+        if not samples:
+            return fixed_payload
+        q = dict(query)
+        for field, values in samples.items():
+            q[field] = values[i % len(values)]
+        return json.dumps(q).encode()
 
     def worker():
         while True:
             with lock:
                 if counter["next"] >= requests:
                     return
+                i = counter["next"]
                 counter["next"] += 1
             req = urllib.request.Request(
                 f"{url}/queries.json",
-                data=payload,
+                data=payload_for(i),
                 method="POST",
                 headers={"Content-Type": "application/json"},
             )
