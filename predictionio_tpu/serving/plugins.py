@@ -90,6 +90,12 @@ def discover_plugins(group: str = ENGINE_GROUP) -> list:
                     "for this group", path, group,
                 )
                 continue
+            # a plugin advertised BOTH ways (installed entry point + a
+            # leftover PIO_PLUGINS entry) — or listed twice in the env
+            # var — must run once: dedup BEFORE instantiating so a
+            # duplicate's __init__ side effects never fire at all
+            if cls in seen:
+                continue
             try:
                 plugin = cls()
             except Exception:
@@ -97,10 +103,6 @@ def discover_plugins(group: str = ENGINE_GROUP) -> list:
                     "PIO_PLUGINS entry %r failed to load; skipping", path
                 )
                 continue
-            # a plugin advertised BOTH ways (installed entry point + a
-            # leftover PIO_PLUGINS entry) — or listed twice in the env
-            # var — must run once, not twice
-            if type(plugin) not in seen:
-                seen.add(type(plugin))
-                out.append(plugin)
+            seen.add(cls)
+            out.append(plugin)
     return out

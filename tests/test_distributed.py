@@ -247,6 +247,86 @@ def test_two_process_cli_train_one_completed_instance(tmp_path):
     assert_one_completed(tmp_path, env)
 
 
+@pytest.mark.slow
+def test_three_process_cli_train_one_completed_instance(tmp_path):
+    """`pio launch -n 3` (VERDICT r4 item 6): every prior multi-process e2e
+    ran n=2; three coordinated hosts (1 device each) exercise the
+    divisibility edges and the 3-way rendezvous. Each worker must scan a
+    proper ~1/3 slice and exactly one COMPLETED instance may exist."""
+    env = sqlite_env(tmp_path)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    seed_ratings(tmp_path, env, "tri", n_users=45, n_items=15)
+    write_engine_json(tmp_path, "tri", {"rank": 3, "numIterations": 2})
+
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "predictionio_tpu.tools.cli", "launch",
+            "--num-processes", "3", "--coordinator-port", str(free_port()),
+            "--", "--verbose", "train",
+        ],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=420,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all 3 processes completed" in r.stdout
+    for p in range(3):
+        assert f"[p{p}] " in r.stdout
+
+    import re
+
+    scans = {
+        int(m.group(1)): (int(m.group(2)), int(m.group(3)), int(m.group(4)))
+        for m in re.finditer(
+            r"sharded ingest p(\d)/3: (\d+) user-pass \+ (\d+) item-pass "
+            r"rows of (\d+) global ratings",
+            r.stdout,
+        )
+    }
+    assert set(scans) == {0, 1, 2}, r.stdout
+    total = scans[0][2]
+    assert sum(s[0] for s in scans.values()) == total  # user passes cover
+    assert sum(s[1] for s in scans.values()) == total  # item passes cover
+    # every worker reads a PROPER slice — roughly 1/3, no full reads
+    for p in range(3):
+        assert 0 < scans[p][0] < total * 0.6, scans
+
+    assert_one_completed(tmp_path, env)
+
+
+def test_sharded_train_rejects_indivisible_host_count():
+    """The shards%hosts divisibility contract (als.py) must fail loudly:
+    8 device shards cannot split over 3 hosts."""
+    import numpy as np
+
+    from predictionio_tpu.data.batch import Interactions
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.als import ALSConfig, train_als
+    from predictionio_tpu.parallel.ingest import ShardedInteractions
+    from predictionio_tpu.parallel.mesh import MeshContext
+
+    ctx8 = MeshContext.create()  # the in-process 8-device virtual mesh
+    rng = np.random.default_rng(0)
+    inter = Interactions(
+        user=rng.integers(0, 9, 60).astype(np.int32),
+        item=rng.integers(0, 6, 60).astype(np.int32),
+        rating=rng.uniform(1, 5, 60).astype(np.float32),
+        t=np.zeros(60),
+        user_map=BiMap.string_int(f"u{i}" for i in range(9)),
+        item_map=BiMap.string_int(f"i{i}" for i in range(6)),
+    )
+    sh = ShardedInteractions(
+        user_rows=inter, item_rows=inter,
+        user_map=inter.user_map, item_map=inter.item_map,
+        user_counts=np.bincount(inter.user, minlength=9).astype(np.int64),
+        item_counts=np.bincount(inter.item, minlength=6).astype(np.int64),
+        process_index=0, num_processes=3,
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        # solver pinned: an exported PIO_ALS_SOLVER=segment would trip the
+        # dense-only check before the divisibility contract under test
+        train_als(ctx8, sh, ALSConfig(rank=3, iterations=1, solver="dense"))
+
+
 def test_aggregate_exit_codes_signal_killed_worker_fails_launch():
     """ADVICE r3 (medium): a signal-killed worker (negative POSIX code) must
     fail the launch even when siblings exited 0 — max() would return 0."""
