@@ -638,22 +638,17 @@ def train_als(
     rating = interactions.rating.astype(np.float32)
 
     dense = cfg.solver == "dense"
-    u_perm = i_perm = None
-    if cfg.rebalance and n_shards > 1:
-        u_perm = _balance_permutation(user, n_users_pad, n_shards)
-        i_perm = _balance_permutation(item, n_items_pad, n_shards)
-    elif dense:
-        # dense bucketing needs per-shard-monotone degrees; LPT already
-        # guarantees that, this is the rebalance-off companion
-        u_perm = _degree_sort_permutation(user, n_users_pad, n_shards)
-        i_perm = _degree_sort_permutation(item, n_items_pad, n_shards)
-    user_blk = u_perm[user] if u_perm is not None else user
-    item_blk = i_perm[item] if i_perm is not None else item
-
     if dense:
-        ub = _make_dense_blocks(user_blk, item_blk, rating, n_users_pad, n_shards)
-        ib = _make_dense_blocks(item_blk, user_blk, rating, n_items_pad, n_shards)
+        ub, ib, u_perm, i_perm = _dense_blocks_for(
+            interactions, cfg, n_shards
+        )
     else:
+        u_perm = i_perm = None
+        if cfg.rebalance and n_shards > 1:
+            u_perm = _balance_permutation(user, n_users_pad, n_shards)
+            i_perm = _balance_permutation(item, n_items_pad, n_shards)
+        user_blk = u_perm[user] if u_perm is not None else user
+        item_blk = i_perm[item] if i_perm is not None else item
         ub = _make_blocks(user_blk, item_blk, rating, n_users_pad, n_shards)
         ib = _make_blocks(item_blk, user_blk, rating, n_items_pad, n_shards)
 
@@ -769,6 +764,85 @@ def train_als(
         item_map=interactions.item_map,
         config=cfg,
     )
+
+
+def _dense_blocks_for(interactions, cfg: ALSConfig, n_shards: int):
+    """The single-host dense prep shared by :func:`train_als` and
+    :func:`dense_step_cost_analysis` — ONE source of truth so the cost
+    analysis always compiles the same program the trainer runs.
+
+    Returns ``(ub, ib, u_perm, i_perm)``; the permutations are never None
+    (dense bucketing needs per-shard-monotone degrees: LPT under
+    rebalance, degree-sort otherwise).
+    """
+    n_users_pad = pad_to_multiple(interactions.n_users, n_shards)
+    n_items_pad = pad_to_multiple(interactions.n_items, n_shards)
+    user = interactions.user.astype(np.int64)
+    item = interactions.item.astype(np.int64)
+    rating = interactions.rating.astype(np.float32)
+    if cfg.rebalance and n_shards > 1:
+        u_perm = _balance_permutation(user, n_users_pad, n_shards)
+        i_perm = _balance_permutation(item, n_items_pad, n_shards)
+    else:
+        u_perm = _degree_sort_permutation(user, n_users_pad, n_shards)
+        i_perm = _degree_sort_permutation(item, n_items_pad, n_shards)
+    ub = _make_dense_blocks(
+        u_perm[user], i_perm[item], rating, n_users_pad, n_shards
+    )
+    ib = _make_dense_blocks(
+        i_perm[item], u_perm[user], rating, n_items_pad, n_shards
+    )
+    return ub, ib, u_perm, i_perm
+
+
+def dense_step_cost_analysis(
+    ctx: MeshContext, interactions, config: Optional[ALSConfig] = None
+) -> dict:
+    """XLA's own cost analysis of ONE compiled dense ALS iteration.
+
+    ``flops`` / ``bytes_accessed`` come from the compiler's model of the
+    ACTUAL optimized per-device HLO — fusion, layout, and gather expansion
+    applied — so a hand cost model's error (e.g. unforeseen gather sector
+    amplification, ``docs/perf_roofline.md``) shows up as a divergence
+    from these numbers instead of staying invisible. Block arrays are
+    built on host for their SHAPES only; compilation uses abstract
+    ``ShapeDtypeStruct`` args, so no factor matrices are materialized.
+    """
+    cfg = config or ALSConfig()
+    if cfg.solver != "dense":
+        raise ValueError("cost analysis models the dense solver")
+    n_shards = ctx.axis_size(DATA_AXIS)
+    n_users_pad = pad_to_multiple(interactions.n_users, n_shards)
+    n_items_pad = pad_to_multiple(interactions.n_items, n_shards)
+    ub, ib, _, _ = _dense_blocks_for(interactions, cfg, n_shards)
+    step = _make_dense_step(ctx.mesh, ub, ib, cfg)
+    rows_repl = ctx.sharding(DATA_AXIS, None)
+    sh_rows = ctx.sharding(DATA_AXIS)
+
+    def abstract(shape, dtype, sharding):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    def abstract_blocks(b: _DenseBlocks):
+        out = []
+        for i in range(len(b.widths)):
+            for a in (b.idx[i], b.rat[i], b.msk[i]):
+                out.append(abstract(a.shape, a.dtype, sh_rows))
+        return tuple(out)
+
+    lowered = step.lower(
+        abstract((n_users_pad, cfg.rank), np.float32, rows_repl),
+        abstract((n_items_pad, cfg.rank), np.float32, rows_repl),
+        abstract_blocks(ub),
+        abstract_blocks(ib),
+    )
+    ca = lowered.compile().cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    return {
+        "flops_per_iter_per_device": ca.get("flops"),
+        "bytes_per_iter_per_device": ca.get("bytes accessed"),
+    }
 
 
 def _sharded_blocks_for_host(sh, n_shards: int, pid: int, n_hosts: int):

@@ -52,3 +52,59 @@ class TestSampleIds:
         z = bench._sample_ids(rng, 1000, 100_000, "zipf", s=1.1)
         u = bench._sample_ids(rng, 1000, 100_000, "uniform", s=1.1)
         assert (z < 50).mean() > 2 * (u < 50).mean()
+
+
+class TestMeasuredUtilization:
+    def test_xla_cost_analysis_positive_and_scales_with_ratings(self):
+        from predictionio_tpu.models.als import (
+            ALSConfig,
+            dense_step_cost_analysis,
+        )
+        from predictionio_tpu.parallel.mesh import MeshContext
+
+        ctx = MeshContext.create()
+        small = bench._make_interactions("uniform", 300, 120, 4_000)
+        big = bench._make_interactions("uniform", 300, 120, 16_000)
+        cfg = ALSConfig(rank=4, solver="dense")
+        ca_s = dense_step_cost_analysis(ctx, small, cfg)
+        ca_b = dense_step_cost_analysis(ctx, big, cfg)
+        assert ca_s["flops_per_iter_per_device"] > 0
+        assert ca_s["bytes_per_iter_per_device"] > 0
+        # 4x the ratings must cost materially more compiled work
+        assert (
+            ca_b["flops_per_iter_per_device"]
+            > 2 * ca_s["flops_per_iter_per_device"]
+        )
+
+    def test_device_busy_parses_device_planes_only(self, tmp_path):
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+        space = xplane_pb2.XSpace()
+        dev = space.planes.add()
+        dev.name = "/device:TPU:0"
+        line = dev.lines.add()
+        for dur in (3_000_000, 2_000_000):  # ps
+            ev = line.events.add()
+            ev.duration_ps = dur
+        host = space.planes.add()
+        host.name = "/host:CPU"
+        hline = host.lines.add()
+        hline.events.add().duration_ps = 999_000_000_000
+        d = tmp_path / "plugins" / "profile" / "x"
+        d.mkdir(parents=True)
+        (d / "vm.xplane.pb").write_bytes(space.SerializeToString())
+        busy, n = bench._device_busy_seconds(str(tmp_path))
+        assert n == 1
+        assert abs(busy - 5e-6) < 1e-12  # host plane excluded
+
+    def test_device_busy_none_without_device_plane(self, tmp_path):
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+        space = xplane_pb2.XSpace()
+        host = space.planes.add()
+        host.name = "/host:CPU"
+        d = tmp_path / "p"
+        d.mkdir()
+        (d / "vm.xplane.pb").write_bytes(space.SerializeToString())
+        busy, n = bench._device_busy_seconds(str(tmp_path))
+        assert busy is None and n == 0
