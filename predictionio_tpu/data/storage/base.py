@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import abc
 import datetime as _dt
+import json
 import re
 import secrets
 from dataclasses import dataclass, field
@@ -183,6 +184,45 @@ class LEvents(abc.ABC):
         filters for events WITHOUT a target (reference quirk preserved at the
         HTTP layer, see EventServer).
         """
+
+    def search(
+        self,
+        app_id: int,
+        text: str,
+        channel_id: Optional[int] = None,
+        limit: Optional[int] = None,
+        **filters,
+    ) -> list[Event]:
+        """Free-text event search — the Elasticsearch query-string role
+        (parity: the ES-backed EVENTDATA store, ``ESPEvents.scala``).
+
+        Case-insensitive substring match of ``text`` against the event
+        name, entity/target ids, and the serialized properties, on top of
+        the usual :meth:`find` field ``filters``. Default implementation
+        filters a ``find`` scan host-side; drivers with a query engine
+        push it down (sqlite ``LIKE``).
+        """
+        needle = text.lower()
+
+        def hit(e: Event) -> bool:
+            hay = [
+                e.event, e.entity_type, e.entity_id,
+                e.target_entity_type or "", e.target_entity_id or "",
+                # real UTF-8, not \uXXXX escapes: 'zürich' must match a
+                # property value 'Zürich' on every driver
+                json.dumps(dict(e.properties or {}), ensure_ascii=False),
+            ]
+            return any(needle in h.lower() for h in hay)
+
+        out: list[Event] = []
+        for e in self.find(app_id, channel_id=channel_id, **filters):
+            # bound checked BEFORE appending: limit=0 (or negative) must
+            # return nothing, matching the sqlite LIMIT pushdown
+            if limit is not None and len(out) >= max(0, limit):
+                break
+            if hit(e):
+                out.append(e)
+        return out
 
     def aggregate_properties(
         self,
@@ -472,6 +512,34 @@ class Channels(abc.ABC):
     def delete(self, channel_id: int) -> bool: ...
 
 
+def _filter_instances(
+    instances, exact, since, until, text, limit, text_fields
+) -> list:
+    """Shared newest-first instance filter behind the ``query`` defaults."""
+    needle = text.lower() if text is not None else None
+    out = []
+    for i in sorted(instances, key=lambda x: x.start_time, reverse=True):
+        # bound checked BEFORE appending: limit=0 (or negative) returns
+        # nothing, matching the sqlite LIMIT pushdown
+        if limit is not None and len(out) >= max(0, limit):
+            break
+        if any(
+            want is not None and getattr(i, attr) != want
+            for attr, want in exact.items()
+        ):
+            continue
+        if since is not None and i.start_time < since:
+            continue
+        if until is not None and i.start_time >= until:
+            continue
+        if needle is not None and not any(
+            needle in (f or "").lower() for f in text_fields(i)
+        ):
+            continue
+        out.append(i)
+    return out
+
+
 class EngineInstances(abc.ABC):
     STATUS_INIT = "INIT"
     STATUS_TRAINING = "TRAINING"
@@ -507,6 +575,42 @@ class EngineInstances(abc.ABC):
     @abc.abstractmethod
     def delete(self, instance_id: str) -> bool: ...
 
+    def query(
+        self,
+        status: Optional[str] = None,
+        engine_factory: Optional[str] = None,
+        engine_variant: Optional[str] = None,
+        since: Optional[_dt.datetime] = None,
+        until: Optional[_dt.datetime] = None,
+        text: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> list[EngineInstance]:
+        """Field-query over train runs, newest-first — the Elasticsearch
+        METADATA search role (parity: ``ESEngineInstances.scala:28-120``,
+        which serves getAll/getCompleted as ES field queries).
+
+        Exact-match ``status``/``engine_factory``/``engine_variant``,
+        ``since``/``until`` on start_time, and case-insensitive free-text
+        ``text`` over the params/batch blobs. Default implementation
+        filters :meth:`get_all`; drivers with a query engine push the
+        predicates down (sqlite ``WHERE``/``LIKE``), the network driver
+        ships them to the storage server.
+        """
+        return _filter_instances(
+            self.get_all(),
+            exact={
+                "status": status,
+                "engine_factory": engine_factory,
+                "engine_variant": engine_variant,
+            },
+            since=since, until=until, text=text, limit=limit,
+            text_fields=lambda i: [
+                i.engine_factory, i.batch, i.engine_variant,
+                i.data_source_params, i.preparator_params,
+                i.algorithms_params, i.serving_params,
+            ],
+        )
+
 
 class EvaluationInstances(abc.ABC):
     STATUS_INIT = "INIT"
@@ -532,3 +636,25 @@ class EvaluationInstances(abc.ABC):
 
     @abc.abstractmethod
     def delete(self, instance_id: str) -> bool: ...
+
+    def query(
+        self,
+        status: Optional[str] = None,
+        evaluation_class: Optional[str] = None,
+        since: Optional[_dt.datetime] = None,
+        until: Optional[_dt.datetime] = None,
+        text: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> list[EvaluationInstance]:
+        """Field-query over evaluation runs, newest-first (the ES METADATA
+        search role — parity ``ESEvaluationInstances.scala``); ``text``
+        searches the evaluator-results blobs."""
+        return _filter_instances(
+            self.get_all(),
+            exact={"status": status, "evaluation_class": evaluation_class},
+            since=since, until=until, text=text, limit=limit,
+            text_fields=lambda i: [
+                i.evaluation_class, i.engine_params_generator_class,
+                i.batch, i.evaluator_results, i.evaluator_results_json,
+            ],
+        )

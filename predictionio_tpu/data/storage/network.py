@@ -203,7 +203,10 @@ FRAMES_CONTENT_TYPE = "application/x-pio-frames"
 # "sharded_scan": find/find_interactions accept shard=(index, count) +
 # shard_key pushdown (a pre-sharding server 400s LOUDLY on them — silently
 # returning full data to every worker would duplicate ratings N×).
-SERVER_CAPABILITIES = frozenset({"framed_scan", "sharded_scan"})
+# "search_query": LEvents search + EngineInstances/EvaluationInstances
+# query evaluate server-side; clients without the advertisement fall back
+# to the base-class host-side filter over the legacy wire.
+SERVER_CAPABILITIES = frozenset({"framed_scan", "sharded_scan", "search_query"})
 
 
 def batch_from_npz(data: bytes) -> EventBatch:
@@ -371,6 +374,17 @@ class StorageServer:
             if method == "find":
                 kwargs = _find_kwargs_from_wire(args)
                 events = le.find(app_id, channel_id=channel_id, **kwargs)
+                return json_response(
+                    200, {"result": [e.to_dict() for e in events]}
+                )
+            if method == "search":
+                # the ES query-string role: evaluated next to the backing
+                # store (sqlite pushes it into SQL); matches-only wire
+                text = args.pop("text")
+                kwargs = _find_kwargs_from_wire(args)
+                events = le.search(
+                    app_id, text, channel_id=channel_id, **kwargs
+                )
                 return json_response(
                     200, {"result": [e.to_dict() for e in events]}
                 )
@@ -604,6 +618,20 @@ _META_HANDLERS = {
         _instance_from_wire(base.EngineInstance, a["instance"])
     ),
     ("engineinstances", "delete"): lambda s, a: _eng(s).delete(a["instance_id"]),
+    # the ES search-role query runs on the server, NEXT TO the backing
+    # store (which may push it into SQL) — only matches cross the wire
+    ("engineinstances", "query"): lambda s, a: [
+        _instance_to_wire(i)
+        for i in _eng(s).query(
+            status=a.get("status"),
+            engine_factory=a.get("engine_factory"),
+            engine_variant=a.get("engine_variant"),
+            since=_dt_from_wire(a.get("since")),
+            until=_dt_from_wire(a.get("until")),
+            text=a.get("text"),
+            limit=a.get("limit"),
+        )
+    ],
     # EvaluationInstances
     ("evaluationinstances", "insert"): lambda s, a: _ev(s).insert(
         _instance_from_wire(base.EvaluationInstance, a["instance"])
@@ -621,6 +649,17 @@ _META_HANDLERS = {
         _instance_from_wire(base.EvaluationInstance, a["instance"])
     ),
     ("evaluationinstances", "delete"): lambda s, a: _ev(s).delete(a["instance_id"]),
+    ("evaluationinstances", "query"): lambda s, a: [
+        _instance_to_wire(i)
+        for i in _ev(s).query(
+            status=a.get("status"),
+            evaluation_class=a.get("evaluation_class"),
+            since=_dt_from_wire(a.get("since")),
+            until=_dt_from_wire(a.get("until")),
+            text=a.get("text"),
+            limit=a.get("limit"),
+        )
+    ],
     # Sequences (ESSequences role): the backing DAO's atomicity makes the
     # networked counter cluster-wide — every client sees a unique value
     ("sequences", "gen_next"): lambda s, a: s.get_meta_data_sequences().gen_next(
@@ -825,6 +864,19 @@ class NetworkLEvents(base.LEvents):
         # data (parity: JDBCLEvents SQL WHERE pushdown)
         wire = _find_kwargs_to_wire(kwargs)
         rows = self._call("find", app_id, channel_id, **wire)
+        return [Event.from_dict(d) for d in rows]
+
+    def search(self, app_id, text, channel_id=None, limit=None, **kwargs):
+        # ES-role passthrough: text match runs server-side, only hits
+        # cross the wire. A pre-capability server doesn't speak the route;
+        # fall back to the base host-side filter over the legacy find wire
+        # (rolling-upgrade contract, see capabilities())
+        if "search_query" not in self._c.capabilities():
+            return super().search(
+                app_id, text, channel_id=channel_id, limit=limit, **kwargs
+            )
+        wire = _find_kwargs_to_wire(dict(kwargs, limit=limit))
+        rows = self._call("search", app_id, channel_id, text=text, **wire)
         return [Event.from_dict(d) for d in rows]
 
     def aggregate_properties(self, app_id, entity_type, channel_id=None,
@@ -1089,6 +1141,28 @@ class NetworkEngineInstances(_MetaClient, base.EngineInstances):
     def delete(self, instance_id):
         return self._call("delete", instance_id=instance_id)
 
+    def query(self, status=None, engine_factory=None, engine_variant=None,
+              since=None, until=None, text=None, limit=None):
+        # passthrough: the server evaluates next to its backing store, so
+        # only matching instances cross the wire (not get_all); legacy
+        # servers get the base host-side filter instead
+        if "search_query" not in self._c.capabilities():
+            return super().query(
+                status=status, engine_factory=engine_factory,
+                engine_variant=engine_variant, since=since, until=until,
+                text=text, limit=limit,
+            )
+        return [
+            _instance_from_wire(base.EngineInstance, d)
+            for d in self._call(
+                "query", status=status, engine_factory=engine_factory,
+                engine_variant=engine_variant,
+                since=_dt_to_wire(since) if since else None,
+                until=_dt_to_wire(until) if until else None,
+                text=text, limit=limit,
+            )
+        ]
+
 
 class NetworkEvaluationInstances(_MetaClient, base.EvaluationInstances):
     dao = "evaluationinstances"
@@ -1118,3 +1192,20 @@ class NetworkEvaluationInstances(_MetaClient, base.EvaluationInstances):
 
     def delete(self, instance_id):
         return self._call("delete", instance_id=instance_id)
+
+    def query(self, status=None, evaluation_class=None, since=None,
+              until=None, text=None, limit=None):
+        if "search_query" not in self._c.capabilities():
+            return super().query(
+                status=status, evaluation_class=evaluation_class,
+                since=since, until=until, text=text, limit=limit,
+            )
+        return [
+            _instance_from_wire(base.EvaluationInstance, d)
+            for d in self._call(
+                "query", status=status, evaluation_class=evaluation_class,
+                since=_dt_to_wire(since) if since else None,
+                until=_dt_to_wire(until) if until else None,
+                text=text, limit=limit,
+            )
+        ]

@@ -73,6 +73,34 @@ class _Db:
                 self.conn.execute("PRAGMA journal_mode=WAL")
             self.conn.execute("PRAGMA synchronous=NORMAL")
             self.conn.executescript(_SCHEMA)
+            # free-text containment with PYTHON case folding: SQLite's
+            # LIKE folds ASCII only, which would silently diverge from the
+            # base drivers' str.lower() semantics on non-ASCII ids
+            self.conn.create_function(
+                "pio_contains", 2,
+                lambda hay, needle: (
+                    int(needle in hay.lower()) if hay is not None else 0
+                ),
+                deterministic=True,
+            )
+            # one-time migration (user_version 0 → 1): rows written by
+            # older builds stored properties with \uXXXX escapes, which
+            # the pio_contains pushdown would miss while the base
+            # host-side default (re-serializing the live dict) matches —
+            # re-encode them as the real UTF-8 new writes use
+            if self.conn.execute("PRAGMA user_version").fetchone()[0] < 1:
+                escaped = self.conn.execute(
+                    "SELECT rowid, properties FROM events "
+                    "WHERE instr(properties, ?) > 0",
+                    ("\\u",),
+                ).fetchall()
+                for rid, props in escaped:
+                    self.conn.execute(
+                        "UPDATE events SET properties = ? WHERE rowid = ?",
+                        (json.dumps(json.loads(props), ensure_ascii=False),
+                         rid),
+                    )
+                self.conn.execute("PRAGMA user_version = 1")
             self.conn.commit()
 
 
@@ -132,6 +160,40 @@ class _SqliteDAO:
     @property
     def lock(self):
         return self._db.lock
+
+    def _query_instances(self, table, exact, text_cols, since, until, text,
+                         limit):
+        """Shared WHERE/pio_contains/limit builder behind the instance
+        ``query`` pushdowns (the SQL mirror of base._filter_instances);
+        the subclass supplies ``_COLS``/``_row``."""
+        where, params = [], []
+        for col, val in exact:
+            if val is not None:
+                where.append(f"{col} = ?")
+                params.append(val)
+        if since is not None:
+            where.append("start_time >= ?")
+            params.append(_ts(since))
+        if until is not None:
+            where.append("start_time < ?")
+            params.append(_ts(until))
+        if text is not None:
+            where.append(
+                "(" + " OR ".join(
+                    f"pio_contains({c}, ?)" for c in text_cols
+                ) + ")"
+            )
+            params.extend([text.lower()] * len(text_cols))
+        sql = f"SELECT {self._COLS} FROM {table}"
+        if where:
+            sql += " WHERE " + " AND ".join(where)
+        sql += " ORDER BY start_time DESC"
+        if limit is not None:
+            sql += " LIMIT ?"
+            params.append(max(0, limit))
+        with self.lock:
+            rows = self.conn.execute(sql, params).fetchall()
+        return [self._row(r) for r in rows]
 
 
 def _chan(channel_id: Optional[int]) -> int:
@@ -240,7 +302,7 @@ class SqliteLEvents(_SqliteDAO, base.LEvents):
                     event.entity_id,
                     event.target_entity_type,
                     event.target_entity_id,
-                    json.dumps(event.properties.to_dict()),
+                    json.dumps(event.properties.to_dict(), ensure_ascii=False),
                     _ts(event.event_time),
                     json.dumps(list(event.tags)),
                     event.pr_id,
@@ -266,7 +328,7 @@ class SqliteLEvents(_SqliteDAO, base.LEvents):
                     event.entity_id,
                     event.target_entity_type,
                     event.target_entity_id,
-                    json.dumps(event.properties.to_dict()),
+                    json.dumps(event.properties.to_dict(), ensure_ascii=False),
                     _ts(event.event_time),
                     json.dumps(list(event.tags)),
                     event.pr_id,
@@ -326,6 +388,49 @@ class SqliteLEvents(_SqliteDAO, base.LEvents):
         sql = f"SELECT * FROM events WHERE {where} ORDER BY event_time {order}, creation_time {order}"
         if limit is not None and limit >= 0:
             sql += f" LIMIT {int(limit)}"
+        with self.lock:
+            rows = self.conn.execute(sql, params).fetchall()
+        return [_row_to_event(r) for r in rows]
+
+    _SEARCH_FILTERS = (
+        "start_time", "until_time", "entity_type", "entity_id",
+        "event_names", "target_entity_type", "target_entity_id", "reversed",
+    )
+
+    def search(self, app_id, text, channel_id=None, limit=None, **filters):
+        """Free-text event search pushed into SQL (the ES query-string
+        role): ``pio_contains`` (Python case folding, same semantics as
+        the base default) over event name, entity/target ids, and the
+        serialized properties column, next to the data."""
+        unknown = set(filters) - set(self._SEARCH_FILTERS)
+        if unknown:
+            # the base default raises TypeError through find(); match it
+            raise TypeError(f"search() got unexpected filters {unknown}")
+        where, params = _event_where(
+            app_id,
+            channel_id,
+            filters.get("start_time"),
+            filters.get("until_time"),
+            filters.get("entity_type"),
+            filters.get("entity_id"),
+            filters.get("event_names"),
+            filters.get("target_entity_type"),
+            filters.get("target_entity_id"),
+        )
+        cols = ("event", "entity_type", "entity_id", "target_entity_type",
+                "target_entity_id", "properties")
+        where += (
+            " AND (" + " OR ".join(f"pio_contains({c}, ?)" for c in cols)
+            + ")"
+        )
+        params = list(params) + [text.lower()] * len(cols)
+        order = "DESC" if filters.get("reversed") else "ASC"
+        sql = (
+            f"SELECT * FROM events WHERE {where} "
+            f"ORDER BY event_time {order}, creation_time {order}"
+        )
+        if limit is not None:
+            sql += f" LIMIT {max(0, int(limit))}"
         with self.lock:
             rows = self.conn.execute(sql, params).fetchall()
         return [_row_to_event(r) for r in rows]
@@ -685,6 +790,25 @@ class SqliteEngineInstances(_SqliteDAO, base.EngineInstances):
             ).fetchall()
         return [self._row(r) for r in rows]
 
+    def query(self, status=None, engine_factory=None, engine_variant=None,
+              since=None, until=None, text=None, limit=None):
+        """The ES search-role with predicates pushed into SQL (WHERE +
+        ``pio_contains`` case-folded text over the params/batch blobs)."""
+        return self._query_instances(
+            table="engine_instances",
+            exact=(
+                ("status", status),
+                ("engine_factory", engine_factory),
+                ("engine_variant", engine_variant),
+            ),
+            text_cols=(
+                "engine_factory", "batch", "engine_variant",
+                "data_source_params", "preparator_params",
+                "algorithms_params", "serving_params",
+            ),
+            since=since, until=until, text=text, limit=limit,
+        )
+
     def update(self, instance: base.EngineInstance) -> bool:
         with self.lock:
             cur = self.conn.execute(
@@ -757,6 +881,24 @@ class SqliteEvaluationInstances(_SqliteDAO, base.EvaluationInstances):
             )
             self.conn.commit()
         return instance.id
+
+    def query(self, status=None, evaluation_class=None, since=None,
+              until=None, text=None, limit=None):
+        """ES search-role pushdown (mirrors SqliteEngineInstances.query);
+        the host-side default would deserialize every row INCLUDING the
+        evaluator_results_html/json blobs before filtering."""
+        return self._query_instances(
+            table="evaluation_instances",
+            exact=(
+                ("status", status),
+                ("evaluation_class", evaluation_class),
+            ),
+            text_cols=(
+                "evaluation_class", "engine_params_generator_class",
+                "batch", "evaluator_results", "evaluator_results_json",
+            ),
+            since=since, until=until, text=text, limit=limit,
+        )
 
     def get(self, instance_id: str):
         with self.lock:

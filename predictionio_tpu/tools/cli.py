@@ -541,6 +541,48 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_instances(args) -> int:
+    """Field-query train/eval runs (the Elasticsearch METADATA search
+    role, ESEngineInstances.scala:28-120) — `pio instances --status
+    COMPLETED --text als --limit 5`."""
+    from predictionio_tpu.data.event import parse_time_or_none
+
+    storage = _storage()
+    kwargs = dict(
+        status=args.status,
+        since=parse_time_or_none(args.since) if args.since else None,
+        until=parse_time_or_none(args.until) if args.until else None,
+        text=args.text,
+        limit=args.limit,
+    )
+    if args.eval:
+        if args.variant:
+            return _die("--variant does not apply to --eval instances")
+        dao = storage.get_meta_data_evaluation_instances()
+        rows = dao.query(evaluation_class=args.factory, **kwargs)
+        cols = ["id", "status", "start_time", "evaluation_class", "batch"]
+    else:
+        dao = storage.get_meta_data_engine_instances()
+        rows = dao.query(engine_factory=args.factory,
+                         engine_variant=args.variant, **kwargs)
+        cols = ["id", "status", "start_time", "engine_factory",
+                "engine_variant", "batch"]
+    if args.json:
+        out = [
+            {c: (str(getattr(i, c)) if c == "start_time" else getattr(i, c))
+             for c in cols}
+            for i in rows
+        ]
+        print(json.dumps(out))
+        return 0
+    header = "  ".join(f"{c:<20}" for c in cols)
+    print(header)
+    for i in rows:
+        print("  ".join(f"{str(getattr(i, c)):<20.20}" for c in cols))
+    print(f"[INFO] {len(rows)} instance(s)")
+    return 0
+
+
 def cmd_loadtest(args) -> int:
     from predictionio_tpu.tools.loadtest import run_loadtest
 
@@ -739,6 +781,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ip", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=9000)
     sp.set_defaults(func=cmd_dashboard)
+
+    sp = sub.add_parser(
+        "instances",
+        help="field-query train/eval runs (the ES metadata-search role)",
+    )
+    sp.add_argument("--status")
+    sp.add_argument("--factory", help="engineFactory (or evaluation class)")
+    sp.add_argument("--variant")
+    sp.add_argument("--since", help="ISO time lower bound on start_time")
+    sp.add_argument("--until", help="ISO time upper bound on start_time")
+    sp.add_argument("--text", help="free-text match over params/results")
+    sp.add_argument("--limit", type=int)
+    sp.add_argument("--eval", action="store_true",
+                    help="query evaluation instances instead")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(func=cmd_instances)
 
     sp = sub.add_parser("loadtest")
     sp.add_argument("--ip", default="127.0.0.1")

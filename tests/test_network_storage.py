@@ -476,3 +476,47 @@ class TestPreShardingServer:
         assert len(pe.find(1)) == 0
         with pytest.raises(NetworkStorageError):
             pe.find(1, shard=(0, 2), shard_key="entity")
+
+
+class TestSearchQueryCapability:
+    def test_search_and_query_fall_back_on_legacy_server(
+        self, served, monkeypatch
+    ):
+        """A pre-upgrade server advertises no `search_query`: the client
+        must evaluate host-side over the legacy wire (find/get_all), never
+        dial the new routes (rolling-upgrade contract)."""
+        import datetime as dt
+
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import base
+        from predictionio_tpu.data.storage import network as net
+
+        backing, client = served["backing"], served["client"]
+        le_back = backing.get_l_events()
+        le_back.init(5)
+        le_back.insert(
+            Event(event="rate", entity_type="user", entity_id="Ünïque"), 5
+        )
+        now = dt.datetime.now(tz=dt.timezone.utc)
+        backing.get_meta_data_engine_instances().insert(base.EngineInstance(
+            id="", status="COMPLETED", start_time=now, end_time=now,
+            engine_id="e", engine_version="1", engine_variant="default",
+            engine_factory="f", algorithms_params='[{"name":"als"}]',
+        ))
+        monkeypatch.setattr(net, "SERVER_CAPABILITIES", frozenset())
+        # wrong-route calls must blow up loudly, proving the fallback path
+        monkeypatch.setitem(
+            net._META_HANDLERS, ("engineinstances", "query"),
+            lambda s, a: (_ for _ in ()).throw(AssertionError("new route")),
+        )
+        hits = client.get_l_events().search(5, "ünïque")
+        assert [e.entity_id for e in hits] == ["Ünïque"]
+        got = client.get_meta_data_engine_instances().query(text="als")
+        assert len(got) == 1 and got[0].status == "COMPLETED"
+
+    def test_search_query_advertised_and_served(self, served):
+        from predictionio_tpu.data.storage import network as net
+
+        assert "search_query" in net.SERVER_CAPABILITIES
+        eis = served["client"].get_meta_data_engine_instances()
+        assert "search_query" in eis._c.capabilities()

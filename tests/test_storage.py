@@ -137,6 +137,47 @@ class TestLEventsConformance:
         got = list(le.find(self.APP, limit=2, reversed=True))
         assert [e.event for e in got] == ["$set", "buy"]
 
+    def test_free_text_search(self, store):
+        """The ES query-string role over events: case-insensitive
+        substring over names, ids, AND serialized properties — same
+        results on every driver (sqlite pushes a LIKE into SQL)."""
+        le = store.get_l_events()
+        le.init(self.APP)
+        le.insert(
+            ev("rate", "u1", t=0, target="i1", props={"color": "ultraMarine"}),
+            self.APP,
+        )
+        le.insert(ev("rate", "u2", t=10, target="i2"), self.APP)
+        le.insert(ev("signup", "marinette", t=20), self.APP)
+
+        # properties content, case-insensitive
+        hits = le.search(self.APP, "ultramarine")
+        assert len(hits) == 1 and hits[0].entity_id == "u1"
+        # entity ids and event names are searched too
+        assert {e.entity_id for e in le.search(self.APP, "marine")} == {
+            "u1", "marinette",
+        }
+        assert len(le.search(self.APP, "signup")) == 1
+        # composes with find filters + limit
+        assert len(le.search(self.APP, "marine", event_names=["rate"])) == 1
+        assert len(le.search(self.APP, "u", limit=2)) == 2
+        # LIKE metacharacters stay literal
+        assert le.search(self.APP, "100%") == []
+        assert le.search(self.APP, "nothing-matches") == []
+        # non-ASCII case folding is identical on every driver (sqlite's
+        # built-in LIKE would fold ASCII only) — for ids AND property
+        # values (\uXXXX-escaped JSON haystacks would miss the latter)
+        le.insert(ev("rate", "CAFÉ", t=30, props={"city": "Zürich"}),
+                  self.APP)
+        assert [e.entity_id for e in le.search(self.APP, "café")] == ["CAFÉ"]
+        assert [e.entity_id for e in le.search(self.APP, "zürich")] == ["CAFÉ"]
+        # limit=0 returns nothing, reversed flips order — on all drivers
+        assert le.search(self.APP, "u", limit=0) == []
+        fwd = [e.entity_id for e in le.search(self.APP, "marine")]
+        rev = [e.entity_id for e in le.search(self.APP, "marine",
+                                              reversed=True)]
+        assert rev == fwd[::-1]
+
     def test_channel_isolation(self, store):
         # parity: storage/hbase/src/test/.../PEventsSpec.scala:113
         le = store.get_l_events()
@@ -250,6 +291,62 @@ class TestMetaData:
         inst.evaluator_results = "p@k=0.5"
         assert evs.update(inst)
         assert evs.get_completed()[0].evaluator_results == "p@k=0.5"
+
+    def test_engine_instance_query(self, store):
+        """The Elasticsearch METADATA search role (parity:
+        ESEngineInstances.scala:28-120): field-query + free-text over
+        train runs, same behavior on every driver (memory host-filter,
+        sqlite SQL pushdown, network server-side passthrough)."""
+        eis = store.get_meta_data_engine_instances()
+        now = dt.datetime.now(tz=UTC)
+
+        def mk(status, start, factory="f", variant="default", params=""):
+            return base.EngineInstance(
+                id="", status=status, start_time=start, end_time=start,
+                engine_id="e1", engine_version="1", engine_variant=variant,
+                engine_factory=factory, algorithms_params=params,
+            )
+
+        i1 = eis.insert(mk(eis.STATUS_COMPLETED, now, params='[{"name":"als","rank":100}]'))
+        i2 = eis.insert(mk(
+            eis.STATUS_COMPLETED, now + dt.timedelta(seconds=5),
+            factory="other.Factory", params='[{"name":"cooccurrence"}]',
+        ))
+        i3 = eis.insert(mk(eis.STATUS_ABORTED, now + dt.timedelta(seconds=9)))
+        # status filter, newest first
+        got = eis.query(status=eis.STATUS_COMPLETED)
+        assert [i.id for i in got] == [i2, i1]
+        # factory filter
+        assert [i.id for i in eis.query(engine_factory="other.Factory")] == [i2]
+        # free-text over params blobs, case-insensitive
+        assert [i.id for i in eis.query(text="ALS")] == [i1]
+        assert [i.id for i in eis.query(text="cooccurrence")] == [i2]
+        # LIKE metacharacters are literal, not wildcards
+        assert eis.query(text="a%s") == []
+        # time range [since, until)
+        got = eis.query(since=now + dt.timedelta(seconds=1),
+                        until=now + dt.timedelta(seconds=8))
+        assert [i.id for i in got] == [i2]
+        # limit caps newest-first; limit=0 returns nothing on all drivers
+        assert [i.id for i in eis.query(limit=1)] == [i3]
+        assert eis.query(limit=0) == []
+
+    def test_evaluation_instance_query(self, store):
+        evs = store.get_meta_data_evaluation_instances()
+        now = dt.datetime.now(tz=UTC)
+        i1 = evs.insert(base.EvaluationInstance(
+            id="", status=evs.STATUS_COMPLETED, start_time=now, end_time=now,
+            evaluation_class="PrecisionEval", evaluator_results="p@k=0.5",
+        ))
+        evs.insert(base.EvaluationInstance(
+            id="", status=evs.STATUS_INIT,
+            start_time=now + dt.timedelta(seconds=3),
+            end_time=now, evaluation_class="RecallEval",
+        ))
+        assert [i.id for i in evs.query(status=evs.STATUS_COMPLETED)] == [i1]
+        assert [i.id for i in evs.query(evaluation_class="PrecisionEval")] == [i1]
+        assert [i.id for i in evs.query(text="p@k")] == [i1]
+        assert len(evs.query()) == 2
 
     def test_models_blob(self, store):
         models = store.get_model_data_models()
@@ -427,3 +524,41 @@ class TestSequences:
         for t in threads:
             t.join()
         assert sorted(got) == list(range(1, 101))  # unique + gapless
+
+
+class TestSqliteLegacyMigration:
+    def test_escaped_properties_rows_migrated_on_open(self, tmp_path):
+        """Rows written by older builds stored \\uXXXX-escaped properties;
+        the one-time user_version migration must re-encode them so the
+        pio_contains search pushdown sees the same haystack as the base
+        host-side default."""
+        import json as jsonlib
+        import sqlite3
+
+        from predictionio_tpu.data.storage import sqlite as sq
+
+        path = str(tmp_path / "legacy.sqlite")
+        db = sq.get_db(path)
+        le = sq.SqliteLEvents(path=path)
+        le.init(1)
+        # simulate an OLD build: raw escaped row + pre-migration version
+        with db.lock:
+            db.conn.execute(
+                "INSERT INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                ("e1", 1, 0, "rate", "user", "u1", None, None,
+                 jsonlib.dumps({"city": "Zürich"}),  # ensure_ascii → \u
+                 0.0, "[]", None, 0.0),
+            )
+            db.conn.execute("PRAGMA user_version = 0")
+            db.conn.commit()
+        assert "\\u" in db.conn.execute(
+            "SELECT properties FROM events").fetchone()[0]
+        sq.close_db(path)
+        # reopen: migration runs once, search now matches
+        le = sq.SqliteLEvents(path=path)
+        hits = le.search(1, "zürich")
+        assert [e.entity_id for e in hits] == ["u1"]
+        raw = le.conn.execute("SELECT properties FROM events").fetchone()[0]
+        assert "Zürich" in raw and "\\u" not in raw
+        assert le.conn.execute("PRAGMA user_version").fetchone()[0] == 1
+        sq.close_db(path)

@@ -68,6 +68,34 @@ class TestCliAppCommands:
         assert key in capsys.readouterr().out
         assert run_cli("accesskey", "delete", key) == 0
 
+    def test_instances_query(self, cli_env, capsys):
+        """`pio instances` — the ES metadata-search role at the CLI."""
+        import datetime as dt
+        import json as jsonlib
+
+        from predictionio_tpu.data.storage import base as sbase
+        from predictionio_tpu.data.storage.registry import Storage as St
+
+        eis = St.instance().get_meta_data_engine_instances()
+        now = dt.datetime.now(tz=dt.timezone.utc)
+        for status, params in (
+            ("COMPLETED", '[{"name":"als"}]'),
+            ("ABORTED", '[{"name":"nb"}]'),
+        ):
+            eis.insert(sbase.EngineInstance(
+                id="", status=status, start_time=now, end_time=now,
+                engine_id="e", engine_version="1", engine_variant="default",
+                engine_factory="my.Factory", algorithms_params=params,
+            ))
+        assert run_cli("instances", "--status", "COMPLETED", "--json") == 0
+        rows = jsonlib.loads(capsys.readouterr().out)
+        assert len(rows) == 1 and rows[0]["status"] == "COMPLETED"
+        assert run_cli("instances", "--text", "als") == 0
+        out = capsys.readouterr().out
+        assert "1 instance(s)" in out and "my.Factory" in out
+        assert run_cli("instances", "--eval", "--json") == 0
+        assert jsonlib.loads(capsys.readouterr().out) == []
+
     def test_status(self, cli_env, capsys):
         assert run_cli("status") == 0
         assert "ready to go" in capsys.readouterr().out
