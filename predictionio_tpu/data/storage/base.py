@@ -205,14 +205,17 @@ class LEvents(abc.ABC):
         needle = text.lower()
 
         def hit(e: Event) -> bool:
-            hay = [
+            # cheap string fields first; the properties json.dumps (real
+            # UTF-8, not \uXXXX escapes — 'zürich' must match 'Zürich' on
+            # every driver) is paid only when nothing cheaper matched
+            hay = (
                 e.event, e.entity_type, e.entity_id,
                 e.target_entity_type or "", e.target_entity_id or "",
-                # real UTF-8, not \uXXXX escapes: 'zürich' must match a
-                # property value 'Zürich' on every driver
-                json.dumps(dict(e.properties or {}), ensure_ascii=False),
-            ]
-            return any(needle in h.lower() for h in hay)
+            )
+            return any(needle in h.lower() for h in hay) or needle in (
+                json.dumps(dict(e.properties or {}), ensure_ascii=False)
+                .lower()
+            )
 
         out: list[Event] = []
         for e in self.find(app_id, channel_id=channel_id, **filters):
@@ -476,7 +479,14 @@ class Apps(abc.ABC):
 class AccessKeys(abc.ABC):
     @staticmethod
     def generate_key() -> str:
-        return secrets.token_urlsafe(48)
+        # urlsafe-base64 may START with '-', which every CLI then parses
+        # as an option flag (`pio accesskey delete -Xyz...` → argparse
+        # error); '_' is excluded too purely for visual symmetry — only
+        # '-' actually breaks parsing
+        while True:
+            key = secrets.token_urlsafe(48)
+            if key[0] not in "-_":
+                return key
 
     @abc.abstractmethod
     def insert(self, access_key: AccessKey) -> Optional[str]:

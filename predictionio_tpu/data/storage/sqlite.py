@@ -187,7 +187,9 @@ class _SqliteDAO:
         sql = f"SELECT {self._COLS} FROM {table}"
         if where:
             sql += " WHERE " + " AND ".join(where)
-        sql += " ORDER BY start_time DESC"
+        # rowid tie-break = insertion order among equal start_times,
+        # matching the base default's stable sort over get_all
+        sql += " ORDER BY start_time DESC, rowid ASC"
         if limit is not None:
             sql += " LIMIT ?"
             params.append(max(0, limit))
@@ -419,11 +421,20 @@ class SqliteLEvents(_SqliteDAO, base.LEvents):
         )
         cols = ("event", "entity_type", "entity_id", "target_entity_type",
                 "target_entity_id", "properties")
-        where += (
-            " AND (" + " OR ".join(f"pio_contains({c}, ?)" for c in cols)
-            + ")"
-        )
+        clauses = [f"pio_contains({c}, ?)" for c in cols]
         params = list(params) + [text.lower()] * len(cols)
+        # rows written by an old build mid-rolling-upgrade (after the
+        # user_version migration already ran) may still carry \uXXXX
+        # escapes: also match the ASCII-escaped form of the needle in the
+        # properties column. Best-effort: an escape of a DIFFERENT case
+        # (stored 'U+00DC' for the capital, needle escaping to 'u+00fc')
+        # still misses; the migration remains the complete fix for
+        # at-rest rows
+        escaped = json.dumps(text.lower(), ensure_ascii=True)[1:-1]
+        if escaped != text.lower():
+            clauses.append("pio_contains(properties, ?)")
+            params.append(escaped)
+        where += " AND (" + " OR ".join(clauses) + ")"
         order = "DESC" if filters.get("reversed") else "ASC"
         sql = (
             f"SELECT * FROM events WHERE {where} "

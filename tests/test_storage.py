@@ -562,3 +562,34 @@ class TestSqliteLegacyMigration:
         assert "Zürich" in raw and "\\u" not in raw
         assert le.conn.execute("PRAGMA user_version").fetchone()[0] == 1
         sq.close_db(path)
+
+
+class TestAccessKeyGeneration:
+    def test_keys_never_start_with_option_chars(self):
+        """A key starting with '-' breaks every CLI that takes it as a
+        positional (argparse reads it as a flag) — regression for a
+        1-in-60 flake in `pio accesskey delete <key>`."""
+        for _ in range(300):
+            assert base.AccessKeys.generate_key()[0] not in "-_"
+
+    def test_escaped_row_written_after_migration_still_found(self, tmp_path):
+        """Mixed-fleet writer: an OLD build inserting an escaped row after
+        user_version=1 must still be searchable — the pushdown also
+        matches the ASCII-escaped form of the needle."""
+        import json as jsonlib
+
+        from predictionio_tpu.data.storage import sqlite as sq
+
+        path = str(tmp_path / "mixed.sqlite")
+        le = sq.SqliteLEvents(path=path)  # migration runs, version=1
+        le.init(1)
+        with le.lock:
+            le.conn.execute(
+                "INSERT INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                ("e2", 1, 0, "rate", "user", "u9", None, None,
+                 jsonlib.dumps({"city": "zürich"}),  # old-build escapes
+                 0.0, "[]", None, 0.0),
+            )
+            le.conn.commit()
+        assert [e.entity_id for e in le.search(1, "zürich")] == ["u9"]
+        sq.close_db(path)
