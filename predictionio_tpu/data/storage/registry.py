@@ -75,6 +75,25 @@ def _register_builtin():
     # S3-compatible MODELDATA (parity: storage/s3 S3Models.scala); works
     # against AWS/MinIO/localstack or the in-repo s3stub
     register_driver("s3", {"Models": s3.S3Models})
+    from predictionio_tpu.data.storage import postgres
+
+    # client/server SQL backend over the v3 wire protocol (parity:
+    # storage/jdbc against PostgreSQL); conformance runs against the
+    # protocol-verifying pgstub, unchanged against a real server
+    register_driver(
+        "postgres",
+        {
+            "LEvents": postgres.PostgresLEvents,
+            "PEvents": postgres.PostgresPEvents,
+            "Models": postgres.PostgresModels,
+            "Apps": postgres.PostgresApps,
+            "AccessKeys": postgres.PostgresAccessKeys,
+            "Channels": postgres.PostgresChannels,
+            "EngineInstances": postgres.PostgresEngineInstances,
+            "EvaluationInstances": postgres.PostgresEvaluationInstances,
+            "Sequences": postgres.PostgresSequences,
+        },
+    )
     from predictionio_tpu.data.storage import network
 
     register_driver(
@@ -185,17 +204,26 @@ class Storage:
         attrs = dict(self._sources[source_name])
         type_name = attrs.pop("type")
         if type_name == "jdbc":
-            # No silent sqlite fallback: a reference pio-env.sh naming a
-            # networked JDBC/Postgres source must not quietly get a local
-            # file (round-1 ADVICE).  The equivalent capability here is the
-            # `network` driver against `pio storageserver`.
-            raise StorageError(
-                f"source {source_name!r}: TYPE=jdbc names a client/server SQL "
-                "database, which this build does not embed. Use TYPE=sqlite "
-                "for a single-host file store, or TYPE=network with "
-                f"PIO_STORAGE_SOURCES_{source_name}_URL=http://host:7077 "
-                "against `pio storageserver` for a shared data plane."
-            )
+            url = attrs.get("url", "")
+            if url.replace("jdbc:", "", 1).startswith(
+                ("postgresql://", "postgres://")
+            ):
+                # drop-in for a reference pio-env.sh: TYPE=jdbc with a
+                # postgres URL resolves to the native wire driver
+                type_name = "postgres"
+            else:
+                # No silent sqlite fallback: a reference pio-env.sh naming
+                # any OTHER networked JDBC source must not quietly get a
+                # local file (round-1 ADVICE).
+                raise StorageError(
+                    f"source {source_name!r}: TYPE=jdbc without a "
+                    "postgresql:// URL names a client/server SQL database "
+                    "this build does not speak. Use TYPE=postgres with "
+                    f"PIO_STORAGE_SOURCES_{source_name}_URL=postgresql://"
+                    "user:pass@host/db, TYPE=sqlite for a single-host "
+                    "file store, or TYPE=network against `pio "
+                    "storageserver` for a shared data plane."
+                )
         if type_name not in DRIVERS:
             raise StorageError(f"unknown storage type {type_name!r}")
         if dao not in DRIVERS[type_name]:
