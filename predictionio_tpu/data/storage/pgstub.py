@@ -298,6 +298,13 @@ class _Session:
         return str(v).encode("utf-8")
 
     def _run_sql(self) -> None:
+        verb0 = (self.stmt_sql.strip().split() or [""])[0].upper()
+        if verb0 == "SET" or "pg_get_serial_sequence" in self.stmt_sql:
+            # session SETs and serial-sequence bumps are PG-only; sqlite's
+            # AUTOINCREMENT already provides the bump semantics
+            self._send(b"n")
+            self._send(b"C", f"{verb0 or 'SELECT'} 0".encode() + b"\x00")
+            return
         sql = _to_sqlite(self.stmt_sql)
         with self.stub.db_lock:
             cur = self.stub.db.execute(sql, self.params)

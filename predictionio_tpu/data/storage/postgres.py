@@ -63,11 +63,15 @@ class PGError(Exception):
         )
 
 
-def _scram_client_messages(user: str, password: str, server_first: bytes,
-                           client_nonce: str, gs2: str = "n,,"):
+def _scram_client_messages(client_first_bare: str, password: str,
+                           server_first: bytes, client_nonce: str,
+                           gs2: str = "n,,"):
     """SCRAM-SHA-256 client-final message + expected server signature.
 
-    RFC 5802 with SHA-256 (RFC 7677). Returns ``(client_final, server_sig)``.
+    RFC 5802 with SHA-256 (RFC 7677). ``client_first_bare`` must be the
+    EXACT bare string previously sent (the auth message hashes the bytes
+    on the wire, not a reconstruction). Returns
+    ``(client_final, server_sig)``.
     """
     attrs = dict(
         p.split("=", 1) for p in server_first.decode("utf-8").split(",")
@@ -80,7 +84,6 @@ def _scram_client_messages(user: str, password: str, server_first: bytes,
     )
     client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
     stored_key = hashlib.sha256(client_key).digest()
-    client_first_bare = f"n={user},r={client_nonce}"
     channel = base64.b64encode(gs2.encode()).decode()
     client_final_bare = f"c={channel},r={nonce}"
     auth_message = (
@@ -198,7 +201,8 @@ class PGConnection:
                     )
                 elif code == 11:  # SASL continue (server-first)
                     final, self._expect_sig = _scram_client_messages(
-                        "", self.password, body[4:], scram_nonce
+                        client_first_sent, self.password, body[4:],
+                        scram_nonce,
                     )
                     self._send(b"p", final)
                 elif code == 12:  # SASL final (server signature)
@@ -341,11 +345,28 @@ class PGConnection:
 
 class _PgDb:
     def __init__(self, url: str):
-        self.conn = PGConnection(url)
+        self.url = url
         self.lock = threading.RLock()
-        with self.lock:
-            for stmt in _SCHEMA:
-                self.conn.execute(stmt)
+        self.conn = self._connect()
+
+    def _connect(self) -> PGConnection:
+        conn = PGConnection(self.url)
+        # hex is the only bytea output format the decoder speaks; pin it
+        # so a server/role-level bytea_output='escape' can't corrupt
+        # model blobs (the stub no-ops SET statements)
+        conn.execute("SET bytea_output = 'hex'")
+        for stmt in _SCHEMA:
+            conn.execute(stmt)
+        return conn
+
+    def reconnect(self) -> None:
+        """Called under ``lock`` after a transport failure: the old socket
+        may be mid-frame (undecodable), so it is always replaced."""
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        self.conn = self._connect()
 
 
 _CONNS: dict[str, _PgDb] = {}
@@ -447,11 +468,34 @@ class _PgDAO:
         self._db = get_pg(url)
 
     def _exec(self, sql: str, params: Iterable[Any] = ()) -> tuple[list, int]:
+        params = list(params)
         with self._db.lock:
-            return self._db.conn.execute(_dollar(sql), params)
+            try:
+                return self._db.conn.execute(_dollar(sql), params)
+            except (ConnectionError, OSError):
+                # dropped/timed-out socket: a long-lived service must not
+                # be permanently poisoned by one broken connection.
+                # Reconnect ALWAYS; auto-retry only reads — a write might
+                # have committed server-side before the link died, and
+                # silently re-applying it is worse than surfacing the error
+                self._db.reconnect()
+                if sql.lstrip()[:6].upper() == "SELECT":
+                    return self._db.conn.execute(_dollar(sql), params)
+                raise
 
 
 # -- events -----------------------------------------------------------------
+
+
+def _advance_serial(dao: "_PgDAO", table: str) -> None:
+    """After an explicit-id insert, push the BIGSERIAL sequence past
+    max(id) so later auto-id inserts can never collide with it (sqlite's
+    AUTOINCREMENT does this implicitly; real PostgreSQL does not — the
+    stub no-ops the setval)."""
+    dao._exec(
+        f"SELECT setval(pg_get_serial_sequence('{table}', 'id'), "
+        f"(SELECT GREATEST(MAX(id), 1) FROM {table}))"
+    )
 
 
 def _event_where(app_id, channel_id, start_time=None, until_time=None,
@@ -687,6 +731,8 @@ class PostgresApps(_PgDAO, base.Apps):
             )
             params = (app.name, app.description)
         rows, _ = self._exec(sql, params)
+        if rows and app.id > 0:
+            _advance_serial(self, "apps")
         return int(rows[0][0]) if rows else None
 
     def get(self, app_id):
@@ -779,6 +825,8 @@ class PostgresChannels(_PgDAO, base.Channels):
                 "ON CONFLICT DO NOTHING RETURNING id",
                 (channel.id, channel.name, channel.app_id),
             )
+            if rows:
+                _advance_serial(self, "channels")
         else:
             rows, _ = self._exec(
                 "INSERT INTO channels (name, app_id) VALUES (?,?) "
@@ -902,7 +950,9 @@ class PostgresEngineInstances(_PgDAO, base.EngineInstances):
         sql = f"SELECT {_EI_COLS} FROM engine_instances"
         if where:
             sql += " WHERE " + " AND ".join(where)
-        sql += " ORDER BY start_time DESC"
+        # id tie-break: deterministic order among equal start_times (PG
+        # physical order is arbitrary; every other driver is stable)
+        sql += " ORDER BY start_time DESC, id ASC"
         if limit is not None:
             sql += f" LIMIT {max(0, int(limit))}"
         rows, _ = self._exec(sql, params)

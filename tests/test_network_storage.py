@@ -427,16 +427,20 @@ class TestRemoteModelRepository:
             store_mod.set_storage(None)
 
 
-class TestJdbcAliasRemoved:
-    def test_jdbc_type_fails_loudly(self):
+class TestJdbcAlias:
+    def test_jdbc_without_postgres_url_fails_loudly(self):
+        """TYPE=jdbc + jdbc:postgresql:// now maps to the native postgres
+        wire driver (test_postgres.py covers the drop-in path); any OTHER
+        jdbc database must still fail loudly, never fall back to a local
+        file."""
         s = Storage(env={
             "PIO_STORAGE_SOURCES_PG_TYPE": "jdbc",
-            "PIO_STORAGE_SOURCES_PG_URL": "jdbc:postgresql://db/pio",
+            "PIO_STORAGE_SOURCES_PG_URL": "jdbc:mysql://db/pio",
             "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "PG",
             "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PG",
             "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "PG",
         })
-        with pytest.raises(StorageError, match="network"):
+        with pytest.raises(StorageError, match="TYPE=postgres"):
             s.get_meta_data_apps()
 
 

@@ -190,3 +190,18 @@ class TestContractFixes:
         assert pg.get_pg(stub["url"]) is db  # one cache key
         pg.close_pg("jdbc:" + stub["url"])
         assert pg._normalize_url(stub["url"]) not in pg._CONNS
+
+    def test_select_reconnects_after_dropped_connection(self, stub):
+        """One dead socket must not poison the process: reads reconnect
+        and retry; the replacement connection serves everything after."""
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.data.storage.postgres import (
+            PostgresApps,
+            get_pg,
+        )
+
+        apps = PostgresApps(url=stub["url"])
+        assert apps.insert(App(0, "reconn")) is not None
+        get_pg(stub["url"]).conn._sock.close()  # server "drops" the link
+        assert apps.get_by_name("reconn").name == "reconn"
+        assert apps.insert(App(0, "after")) is not None  # writes work too
