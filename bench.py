@@ -97,18 +97,20 @@ def _make_interactions(dist: str, n_users: int, n_items: int, n_ratings: int):
     return inter
 
 
-def _timed_run(ctx, inter, rank, iterations, dtype, n_chips):
+def _timed_run(ctx, inter, rank, iterations, dtype, n_chips, rebalance=True):
     from predictionio_tpu.models import als
 
     # warm-up: compile the step (first TPU compile is slow, cached after)
     als.train_als(
-        ctx, inter, als.ALSConfig(rank=rank, iterations=1, compute_dtype=dtype)
+        ctx, inter, als.ALSConfig(rank=rank, iterations=1,
+                                  compute_dtype=dtype, rebalance=rebalance)
     )
     t0 = time.perf_counter()
     model = als.train_als(
         ctx,
         inter,
-        als.ALSConfig(rank=rank, iterations=iterations, compute_dtype=dtype),
+        als.ALSConfig(rank=rank, iterations=iterations, compute_dtype=dtype,
+                      rebalance=rebalance),
     )
     dt = time.perf_counter() - t0
     return len(inter.rating) * iterations / dt / n_chips, model, dt
@@ -191,7 +193,8 @@ def _device_busy_seconds(trace_dir: str) -> tuple:
     return sum(busy(p) for p in device), len(device)
 
 
-def _measured_utilization(ctx, inter, rank, dtype, platform) -> dict:
+def _measured_utilization(ctx, inter, rank, dtype, platform,
+                          rebalance=True) -> dict:
     """MEASURED companions to the analytic cost model (VERDICT r4 weak 2):
 
     * ``measured_device_time_fraction`` — profiler-traced device busy time
@@ -209,12 +212,16 @@ def _measured_utilization(ctx, inter, rank, dtype, platform) -> dict:
 
     out = {}
     # solver pinned to dense: the measured fields model the flagship path
-    # regardless of a PIO_ALS_SOLVER A/B override in the environment
+    # regardless of a PIO_ALS_SOLVER A/B override in the environment;
+    # rebalance follows the benched cell so the trace describes the SAME
+    # layout the record's workload claims
     cfg = als.ALSConfig(
-        rank=rank, iterations=2, compute_dtype=dtype, solver="dense"
+        rank=rank, iterations=2, compute_dtype=dtype, solver="dense",
+        rebalance=rebalance,
     )
     als.train_als(ctx, inter, als.ALSConfig(
         rank=rank, iterations=1, compute_dtype=dtype, solver="dense",
+        rebalance=rebalance,
     ))  # compile outside the trace
     with tempfile.TemporaryDirectory() as td:
         with jax.profiler.trace(td):
@@ -230,6 +237,7 @@ def _measured_utilization(ctx, inter, rank, dtype, platform) -> dict:
         out["traced_wall_sec"] = round(wall, 3)
     ca = als.dense_step_cost_analysis(ctx, inter, als.ALSConfig(
         rank=rank, iterations=1, compute_dtype=dtype, solver="dense",
+        rebalance=rebalance,
     ))
     flops, nbytes = (
         ca["flops_per_iter_per_device"], ca["bytes_per_iter_per_device"]
@@ -404,6 +412,9 @@ def main() -> None:
     dist = os.environ.get("BENCH_DIST", "both")
     if dist not in ("uniform", "zipf", "both"):
         raise SystemExit(f"BENCH_DIST must be uniform|zipf|both, got {dist!r}")
+    # parsed ONCE: the benched layout and the recorded workload flag must
+    # come from the same read (BENCH_REBALANCE=0 = the no-LPT cell)
+    rebalance = os.environ.get("BENCH_REBALANCE", "1") != "0"
 
     ctx = MeshContext.create()
     n_chips = ctx.n_devices
@@ -415,7 +426,7 @@ def main() -> None:
     for d in ("uniform", "zipf") if dist == "both" else (dist,):
         inter = _make_interactions(d, n_users, n_items, n_ratings)
         results[d], models[d], times[d] = _timed_run(
-            ctx, inter, rank, iterations, dtype, n_chips
+            ctx, inter, rank, iterations, dtype, n_chips, rebalance=rebalance
         )
         print(
             f"INFO: {d} distribution: {results[d]:.1f} events/s/chip",
@@ -440,7 +451,8 @@ def main() -> None:
                                                   4_000_000))),
             )
             utilization.update(
-                _measured_utilization(ctx, inter_m, rank, dtype, platform)
+                _measured_utilization(ctx, inter_m, rank, dtype, platform,
+                                      rebalance=rebalance)
             )
         except Exception as e:
             print(f"WARNING: measured utilization failed: {e}",
@@ -530,6 +542,7 @@ def main() -> None:
             "iterations": iterations,
             "dtype": dtype,
             "distribution": primary_dist,
+            "rebalance": rebalance,
         },
     }
     record["utilization"] = utilization

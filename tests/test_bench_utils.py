@@ -108,3 +108,66 @@ class TestMeasuredUtilization:
         (d / "vm.xplane.pb").write_bytes(space.SerializeToString())
         busy, n = bench._device_busy_seconds(str(tmp_path))
         assert busy is None and n == 0
+
+
+class TestBenchMatrix:
+    def _load(self):
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(bench.__file__),
+                            "tools", "bench_matrix.py")
+        spec = importlib.util.spec_from_file_location("bench_matrix", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_any_fallback_cell_never_touches_tpu_artifact(self, tmp_path,
+                                                          monkeypatch):
+        """Cells stage in a side file; the TPU artifact is replaced only
+        when EVERY cell is genuine — a mid-run tunnel death (tpu cells
+        then cpu fallbacks) must leave prior TPU evidence intact."""
+        bm = self._load()
+        out = tmp_path / "BENCH_TPU_MANUAL.json"
+        out.write_text('{"platform": "tpu", "value": 3208643.4}')
+        monkeypatch.setattr(bm, "OUT", str(out))
+        results = iter(
+            [{"platform": "tpu", "fallback": False, "value": 9e6}]
+            + [{"platform": "cpu", "fallback": True, "value": 1.0}] * 10
+        )
+        monkeypatch.setattr(bm, "run_cell", lambda name, o: next(results))
+        rc = bm.main()
+        assert rc == 1  # not all on tpu
+        import json as jsonlib
+
+        # prior TPU evidence untouched; everything staged aside
+        assert jsonlib.loads(out.read_text())["value"] == 3208643.4
+        staging = tmp_path / "BENCH_TPU_MANUAL.staging.json"
+        assert len(jsonlib.loads(staging.read_text())["cells"]) == \
+            len(bm.CELLS)
+
+    def test_all_tpu_run_promotes_to_primary_artifact(self, tmp_path,
+                                                      monkeypatch):
+        bm = self._load()
+        out = tmp_path / "BENCH_TPU_MANUAL.json"
+        monkeypatch.setattr(bm, "OUT", str(out))
+        monkeypatch.setattr(
+            bm, "run_cell",
+            lambda name, o: {"platform": "tpu", "fallback": False,
+                             "value": 5e6},
+        )
+        assert bm.main() == 0
+        import json as jsonlib
+
+        assert len(jsonlib.loads(out.read_text())["cells"]) == len(bm.CELLS)
+        # staging was promoted (renamed), not duplicated
+        assert not (tmp_path / "BENCH_TPU_MANUAL.staging.json").exists()
+
+    def test_cells_pin_every_matrix_axis(self):
+        """An ambient BENCH_REBALANCE/BENCH_DTYPE from a prior manual run
+        must never change what a labeled cell measures."""
+        bm = self._load()
+        for name, overrides in bm.CELLS:
+            assert "BENCH_REBALANCE" in overrides, name
+            assert "BENCH_DTYPE" in overrides, name
+            assert "BENCH_DIST" in overrides, name
