@@ -293,8 +293,11 @@ class PEvents(abc.ABC):
         ``JDBCPEvents.scala:35-119``). ``shard_key`` picks the partition
         rule:
 
-        * ``"row"``    — positional (row i → shard i % count): even split,
-          no locality guarantee.
+        * ``"row"``    — an even DRIVER-DEFINED disjoint split with no
+          locality guarantee (the host-side reference is positional,
+          row i → shard i % count; SQL drivers may hash a stable row key
+          instead). Only disjointness + coverage are contractual; one
+          scan's shards must all come from one driver.
         * ``"entity"`` — ``shard_hash(entity_id) % count``: ALL events of
           one entity land on one shard (what blocked trainers need for the
           user-side pass).

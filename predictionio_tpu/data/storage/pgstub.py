@@ -106,6 +106,16 @@ class PGStub:
             "lower", 1, lambda s: s.lower() if isinstance(s, str) else s,
             deterministic=True,
         )
+        # the server-side shard hash: the driver installs a plpgsql
+        # pio_crc32 (no-op'd by the dialect shim); the stub provides the
+        # SAME function as a Python UDF (both equal zlib.crc32)
+        import zlib
+
+        self.db.create_function(
+            "pio_crc32", 1,
+            lambda s: zlib.crc32(s.encode("utf-8")) if s is not None else 0,
+            deterministic=True,
+        )
         self._server: socketserver.ThreadingTCPServer | None = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -299,7 +309,16 @@ class _Session:
 
     def _run_sql(self) -> None:
         verb0 = (self.stmt_sql.strip().split() or [""])[0].upper()
-        if verb0 == "SET" or "pg_get_serial_sequence" in self.stmt_sql:
+        if "CREATE OR REPLACE FUNCTION" in self.stmt_sql.upper():
+            # plpgsql is PG-only; the stub registered the equivalent UDF
+            self._send(b"n")
+            self._send(b"C", b"CREATE FUNCTION\x00")
+            return
+        if (
+            verb0 == "SET"
+            or "pg_get_serial_sequence" in self.stmt_sql
+            or "pg_advisory_" in self.stmt_sql
+        ):
             # session SETs and serial-sequence bumps are PG-only; sqlite's
             # AUTOINCREMENT already provides the bump semantics
             self._send(b"n")

@@ -349,14 +349,26 @@ class _PgDb:
         self.lock = threading.RLock()
         self.conn = self._connect()
 
+    # cluster-wide advisory-lock key serializing schema replay: CREATE OR
+    # REPLACE FUNCTION always writes pg_proc, and N hosts connecting
+    # concurrently (the multi-host launch) would otherwise race it
+    # ("tuple concurrently updated" on real PostgreSQL)
+    _SCHEMA_LOCK_KEY = 20260730
+
     def _connect(self) -> PGConnection:
         conn = PGConnection(self.url)
         # hex is the only bytea output format the decoder speaks; pin it
         # so a server/role-level bytea_output='escape' can't corrupt
         # model blobs (the stub no-ops SET statements)
         conn.execute("SET bytea_output = 'hex'")
-        for stmt in _SCHEMA:
-            conn.execute(stmt)
+        conn.execute(f"SELECT pg_advisory_lock({self._SCHEMA_LOCK_KEY})")
+        try:
+            for stmt in _SCHEMA:
+                conn.execute(stmt)
+        finally:
+            conn.execute(
+                f"SELECT pg_advisory_unlock({self._SCHEMA_LOCK_KEY})"
+            )
         return conn
 
     def reconnect(self) -> None:
@@ -428,6 +440,31 @@ _SCHEMA = [
   id TEXT PRIMARY KEY, models BYTEA NOT NULL)""",
     """CREATE TABLE IF NOT EXISTS sequences (
   name TEXT PRIMARY KEY, value BIGINT NOT NULL)""",
+    # the cross-driver entity→shard hash (base.PEvents.shard_hash: zlib
+    # crc32 of UTF-8 bytes) as a server-side function, so sharded scans
+    # run IN SQL next to the data (parity: Spark JDBC partitioned reads,
+    # JDBCPEvents.scala:35-119). Reflected CRC-32, bitwise form.
+    """CREATE OR REPLACE FUNCTION pio_crc32(t TEXT) RETURNS BIGINT AS
+$pio$
+DECLARE
+  b BYTEA := convert_to(t, 'UTF8');
+  crc BIGINT := 4294967295;
+  i INT;
+  j INT;
+BEGIN
+  FOR i IN 0..octet_length(b) - 1 LOOP
+    crc := crc # get_byte(b, i);
+    FOR j IN 1..8 LOOP
+      IF (crc & 1) = 1 THEN
+        crc := (crc >> 1) # 3988292384;
+      ELSE
+        crc := crc >> 1;
+      END IF;
+    END LOOP;
+  END LOOP;
+  RETURN crc # 4294967295;
+END
+$pio$ LANGUAGE plpgsql IMMUTABLE PARALLEL SAFE""",
 ]
 
 
@@ -629,11 +666,18 @@ class PostgresLEvents(_PgDAO, base.LEvents):
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,
              entity_type=None, entity_id=None, event_names=None,
              target_entity_type=None, target_entity_id=None, limit=None,
-             reversed=False):
+             reversed=False, _extra_pred=None, _extra_params=()):
+        """``_extra_pred``/``_extra_params`` extend the WHERE clause —
+        the internal hook PostgresPEvents' shard pushdown rides so both
+        paths share ONE query construction (limit/reversed/unknown-filter
+        behavior can never drift)."""
         where, params = _event_where(
             app_id, channel_id, start_time, until_time, entity_type,
             entity_id, event_names, target_entity_type, target_entity_id,
         )
+        if _extra_pred is not None:
+            where += f" AND {_extra_pred}"
+            params = list(params) + list(_extra_params)
         order = "DESC" if reversed else "ASC"
         sql = (
             f"SELECT {_EVENT_COLS} FROM events WHERE {where} "
@@ -681,9 +725,9 @@ class PostgresLEvents(_PgDAO, base.LEvents):
 
 
 class PostgresPEvents(base.PEvents):
-    """Bulk reads over the same table; shard pushdown stays host-side
-    (the networked topologies that need in-SQL sharding use the network
-    driver; parity role: JDBCPEvents partitioned reads)."""
+    """Bulk reads with the shard predicate pushed into SQL via the
+    server-side ``pio_crc32`` (parity: Spark JDBC partitioned reads,
+    JDBCPEvents.scala:35-119) — each host transfers only its 1/N."""
 
     def __init__(self, source_name: str = "default",
                  url: Optional[str] = None, **kw):
@@ -691,10 +735,31 @@ class PostgresPEvents(base.PEvents):
 
     def find(self, app_id, channel_id=None, shard=None, shard_key="row",
              **filters) -> EventBatch:
-        batch = EventBatch.from_events(
-            self._l.find(app_id, channel_id, **filters)
+        if shard is None or int(shard[1]) <= 1:
+            return EventBatch.from_events(
+                self._l.find(app_id, channel_id, **filters)
+            )
+        index, count = int(shard[0]), int(shard[1])
+        if shard_key == "row":
+            # any disjoint covering split satisfies the row contract
+            # (base.PEvents.find: assignment is driver-defined); hashing
+            # the event id is stable under concurrent writes
+            pred = "(pio_crc32(id) % ?) = ?"
+        elif shard_key == "entity":
+            pred = "(pio_crc32(entity_id) % ?) = ?"
+        elif shard_key == "target":
+            pred = (
+                "((CASE WHEN target_entity_id IS NULL THEN 0 "
+                "ELSE pio_crc32(target_entity_id) END) % ?) = ?"
+            )
+        else:
+            raise ValueError(f"unknown shard_key {shard_key!r}")
+        return EventBatch.from_events(
+            self._l.find(
+                app_id, channel_id, _extra_pred=pred,
+                _extra_params=(count, index), **filters,
+            )
         )
-        return self.shard_select(batch, shard, shard_key)
 
     def write(self, events, app_id, channel_id=None):
         self._l.batch_insert(list(events), app_id, channel_id)

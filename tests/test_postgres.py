@@ -205,3 +205,35 @@ class TestContractFixes:
         get_pg(stub["url"]).conn._sock.close()  # server "drops" the link
         assert apps.get_by_name("reconn").name == "reconn"
         assert apps.insert(App(0, "after")) is not None  # writes work too
+
+    def test_sharded_scan_pushes_predicate_into_sql(self, stub, monkeypatch):
+        """The shard filter must run SERVER-side (JDBCPEvents partitioned
+        reads): host-side shard_select raising proves it never runs."""
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import base
+        from predictionio_tpu.data.storage.postgres import PostgresPEvents
+
+        pe = PostgresPEvents(url=stub["url"])
+        pe._l.batch_insert(
+            [Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                   target_entity_type="item", target_entity_id=f"i{i % 4}")
+             for i in range(40)],
+            3,
+        )
+        monkeypatch.setattr(
+            base.PEvents, "shard_select",
+            classmethod(lambda cls, *a: (_ for _ in ()).throw(
+                AssertionError("host-side shard filter ran")
+            )),
+        )
+        parts = [pe.find(3, shard=(i, 3), shard_key="entity")
+                 for i in range(3)]
+        ids = [set(p.entity_id.tolist()) for p in parts]
+        assert sum(len(s) for s in ids) == 40  # disjoint cover (rows)
+        assert not (ids[0] & ids[1]) and not (ids[1] & ids[2])
+        # the assignment matches the cross-driver shard_hash contract
+        import zlib
+
+        for shard_i, s in enumerate(ids):
+            for eid in s:
+                assert zlib.crc32(eid.encode()) % 3 == shard_i
