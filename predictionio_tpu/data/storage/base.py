@@ -316,6 +316,26 @@ class PEvents(abc.ABC):
 
         return zlib.crc32(s.encode("utf-8"))
 
+    @staticmethod
+    def shard_sql_predicate(shard_key: str, row_pred: str) -> str:
+        """The ONE SQL predicate text for in-database shard pushdown.
+
+        Both SQL drivers (sqlite, postgres) expose :meth:`shard_hash` as a
+        ``pio_crc32`` SQL function and bind ``(count, index)``; sharing
+        the predicate here keeps their shard assignments identical by
+        construction. ``row_pred`` supplies the driver-specific row rule
+        (rowid modulo, id hash, ...)."""
+        if shard_key == "row":
+            return row_pred
+        if shard_key == "entity":
+            return "(pio_crc32(entity_id) % ?) = ?"
+        if shard_key == "target":
+            return (
+                "((CASE WHEN target_entity_id IS NULL THEN 0 "
+                "ELSE pio_crc32(target_entity_id) END) % ?) = ?"
+            )
+        raise ValueError(f"unknown shard_key {shard_key!r}")
+
     @classmethod
     def shard_select(
         cls, batch: EventBatch, shard: Optional[tuple], shard_key: str

@@ -447,11 +447,15 @@ _SCHEMA = [
     """CREATE OR REPLACE FUNCTION pio_crc32(t TEXT) RETURNS BIGINT AS
 $pio$
 DECLARE
-  b BYTEA := convert_to(t, 'UTF8');
+  b BYTEA;
   crc BIGINT := 4294967295;
   i INT;
   j INT;
 BEGIN
+  IF t IS NULL THEN
+    RETURN 0;  -- same NULL mapping as the host-side shard_hash guards
+  END IF;
+  b := convert_to(t, 'UTF8');
   FOR i IN 0..octet_length(b) - 1 LOOP
     crc := crc # get_byte(b, i);
     FOR j IN 1..8 LOOP
@@ -740,20 +744,12 @@ class PostgresPEvents(base.PEvents):
                 self._l.find(app_id, channel_id, **filters)
             )
         index, count = int(shard[0]), int(shard[1])
-        if shard_key == "row":
-            # any disjoint covering split satisfies the row contract
-            # (base.PEvents.find: assignment is driver-defined); hashing
-            # the event id is stable under concurrent writes
-            pred = "(pio_crc32(id) % ?) = ?"
-        elif shard_key == "entity":
-            pred = "(pio_crc32(entity_id) % ?) = ?"
-        elif shard_key == "target":
-            pred = (
-                "((CASE WHEN target_entity_id IS NULL THEN 0 "
-                "ELSE pio_crc32(target_entity_id) END) % ?) = ?"
-            )
-        else:
-            raise ValueError(f"unknown shard_key {shard_key!r}")
+        # row rule: any disjoint covering split satisfies the contract
+        # (base.PEvents.find: assignment is driver-defined); hashing the
+        # event id is stable under concurrent writes
+        pred = base.PEvents.shard_sql_predicate(
+            shard_key, "(pio_crc32(id) % ?) = ?"
+        )
         return EventBatch.from_events(
             self._l.find(
                 app_id, channel_id, _extra_pred=pred,

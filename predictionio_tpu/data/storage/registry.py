@@ -40,6 +40,14 @@ def register_driver(type_name: str, daos: dict[str, Callable]) -> None:
     DRIVERS.setdefault(type_name, {}).update(daos)
 
 
+def _is_postgres_jdbc_url(url: str) -> bool:
+    """ONE resolution rule shared by DAO instantiation and `pio status`:
+    a TYPE=jdbc source with a postgres URL maps to the wire driver."""
+    return url.replace("jdbc:", "", 1).startswith(
+        ("postgresql://", "postgres://")
+    )
+
+
 def _register_builtin():
     from predictionio_tpu.data.storage import localfs, memory, sqlite
 
@@ -189,11 +197,18 @@ class Storage:
         return repos
 
     def repository_bindings(self) -> dict[str, tuple[str, str]]:
-        """repository → (source name, driver type), for status displays."""
-        return {
-            repo: (source, self._sources[source].get("type"))
-            for repo, source in self._repos.items()
-        }
+        """repository → (source name, driver type), for status displays;
+        a TYPE=jdbc source that resolves to the postgres wire driver shows
+        the resolution so `pio status` tells the operator what will run."""
+        out = {}
+        for repo, source in self._repos.items():
+            t = self._sources[source].get("type")
+            if t == "jdbc" and _is_postgres_jdbc_url(
+                self._sources[source].get("url", "")
+            ):
+                t = "jdbc→postgres"
+            out[repo] = (source, t)
+        return out
 
     # -- DAO resolution (parity: Storage.getDataObject:310-359) ------------
     def get_data_object(self, repo: str, dao: str):
@@ -204,10 +219,7 @@ class Storage:
         attrs = dict(self._sources[source_name])
         type_name = attrs.pop("type")
         if type_name == "jdbc":
-            url = attrs.get("url", "")
-            if url.replace("jdbc:", "", 1).startswith(
-                ("postgresql://", "postgres://")
-            ):
+            if _is_postgres_jdbc_url(attrs.get("url", "")):
                 # drop-in for a reference pio-env.sh: TYPE=jdbc with a
                 # postgres URL resolves to the native wire driver
                 type_name = "postgres"

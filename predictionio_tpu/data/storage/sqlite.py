@@ -473,21 +473,11 @@ class SqlitePEvents(_SqliteDAO, base.PEvents):
             filters.get("target_entity_type"),
             filters.get("target_entity_id"),
         )
-        if shard_key == "row":
-            # rowid-modulo (disjoint + covering; row positions shift only
-            # if rows were deleted, which never breaks either property)
-            pred = "(rowid % ?) = ?"
-        elif shard_key == "entity":
+        if shard_key in ("entity", "target"):
             self._ensure_shard_udf()
-            pred = "(pio_crc32(entity_id) % ?) = ?"
-        elif shard_key == "target":
-            self._ensure_shard_udf()
-            pred = (
-                "((CASE WHEN target_entity_id IS NULL THEN 0 "
-                "ELSE pio_crc32(target_entity_id) END) % ?) = ?"
-            )
-        else:
-            raise ValueError(f"unknown shard_key {shard_key!r}")
+        # rowid-modulo row rule (disjoint + covering; row positions shift
+        # only if rows were deleted, which never breaks either property)
+        pred = base.PEvents.shard_sql_predicate(shard_key, "(rowid % ?) = ?")
         sql = (
             f"SELECT * FROM events WHERE {where} AND {pred} "
             "ORDER BY event_time ASC, creation_time ASC"

@@ -865,6 +865,12 @@ def main(argv: Optional[list[str]] = None) -> int:
         distributed.initialize()
     try:
         return args.func(args)
+    except BrokenPipeError:
+        # `pio status | head` closing the pipe early is not an error;
+        # devnull the streams so interpreter shutdown can't re-raise
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 0
     except (FileNotFoundError, ValueError, RuntimeError) as e:
         return _die(str(e))
 
