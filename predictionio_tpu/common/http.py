@@ -7,9 +7,11 @@ the compute plane moved to XLA.  Stdlib-only (no external web framework).
 
 from __future__ import annotations
 
+import email.utils
 import json
 import re
 import threading
+import time
 import urllib.parse
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -50,6 +52,48 @@ def json_response(status: int, obj: Any) -> Response:
     return Response(status=status, body=obj)
 
 
+# -- hot-loop response machinery --------------------------------------------
+# The serve path writes ONE buffer per response: a pre-encoded status line +
+# static headers, a per-second cached Date, Content-Length, then the payload
+# — instead of BaseHTTPRequestHandler's one-write-per-header (each a
+# syscall: wfile is unbuffered).
+
+_SERVER_HDR = b"Server: pio-tpu\r\n"
+_STATUS_LINES: dict[int, bytes] = {}
+_CTYPE_HDRS = {
+    "application/json; charset=utf-8": b"Content-Type: application/json; charset=utf-8\r\n",
+    "text/html; charset=utf-8": b"Content-Type: text/html; charset=utf-8\r\n",
+    "application/octet-stream": b"Content-Type: application/octet-stream\r\n",
+}
+_DATE_CACHE: tuple[int, bytes] = (0, b"")
+
+
+def _status_line(status: int) -> bytes:
+    line = _STATUS_LINES.get(status)
+    if line is None:
+        try:
+            from http import HTTPStatus
+
+            phrase = HTTPStatus(status).phrase
+        except ValueError:
+            phrase = ""
+        line = f"HTTP/1.1 {status} {phrase}\r\n".encode("ascii")
+        _STATUS_LINES[status] = line
+    return line
+
+
+def _date_hdr() -> bytes:
+    global _DATE_CACHE
+    now = int(time.time())
+    sec, hdr = _DATE_CACHE
+    if sec != now:
+        hdr = ("Date: " + email.utils.formatdate(now, usegmt=True) + "\r\n").encode(
+            "ascii"
+        )
+        _DATE_CACHE = (now, hdr)
+    return hdr
+
+
 class _Server(ThreadingHTTPServer):
     # The stdlib default accept backlog (5) drops bursts of concurrent
     # connects with ConnectionResetError; the reference's akka-http server
@@ -64,6 +108,10 @@ class HttpService:
     def __init__(self, name: str = "service"):
         self.name = name
         self.routes: list[tuple[str, re.Pattern, Callable[[Request], Response]]] = []
+        # literal patterns (no capture groups / wildcards) dispatch through
+        # one dict hit instead of the regex scan — the hot path for the
+        # query server's fixed routes
+        self._exact: dict[tuple[str, str], Callable[[Request], Response]] = {}
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -72,11 +120,17 @@ class HttpService:
 
         def deco(fn):
             self.routes.append((method.upper(), regex, fn))
+            literal = pattern.replace(r"\.", ".")
+            if not any(c in literal for c in "[](){}?*+|^$\\"):
+                self._exact[(method.upper(), literal)] = fn
             return fn
 
         return deco
 
     def dispatch(self, req: Request) -> Response:
+        fn = self._exact.get((req.method, req.path))
+        if fn is not None:
+            return fn(req)
         path_matched = False
         for method, regex, fn in self.routes:
             m = regex.match(req.path)
@@ -158,15 +212,31 @@ class HttpService:
                     payload = body.encode("utf-8")
                     ctype = ctype or "text/html; charset=utf-8"
                 else:
-                    payload = json.dumps(body).encode("utf-8")
+                    payload = json.dumps(
+                        body, separators=(",", ":")
+                    ).encode("utf-8")
                     ctype = ctype or "application/json; charset=utf-8"
-                self.send_response(resp.status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(payload)))
+                # one write: pre-encoded head + payload. parse_request has
+                # already decided keep-alive vs close from the request's
+                # protocol/Connection header; we only advertise a close we
+                # are about to perform so HTTP/1.1 clients don't re-use a
+                # dying socket.
+                ctype_hdr = _CTYPE_HDRS.get(ctype) or (
+                    b"Content-Type: " + ctype.encode("latin-1") + b"\r\n"
+                )
+                head = [
+                    _status_line(resp.status),
+                    _SERVER_HDR,
+                    _date_hdr(),
+                    ctype_hdr,
+                    b"Content-Length: " + str(len(payload)).encode("ascii") + b"\r\n",
+                ]
                 for k, v in resp.headers.items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(payload)
+                    head.append(f"{k}: {v}\r\n".encode("latin-1"))
+                if self.close_connection:
+                    head.append(b"Connection: close\r\n")
+                head.append(b"\r\n")
+                self.wfile.write(b"".join(head) + payload)
 
             def do_GET(self):
                 self._handle("GET")

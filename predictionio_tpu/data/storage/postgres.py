@@ -487,10 +487,18 @@ def _chan(channel_id: Optional[int]) -> int:
 
 
 def _dollar(sql: str) -> str:
-    """``?`` placeholders → ``$1..$n`` (shared SQL text with sqlite)."""
+    """``?`` placeholders → ``$1..$n`` (shared SQL text with sqlite).
+
+    ``?`` inside single-quoted SQL literals is DATA, not a placeholder —
+    it passes through untouched.  A doubled ``''`` escape toggles the
+    quote state twice, which round-trips correctly."""
     out, n = [], 0
+    in_quote = False
     for ch in sql:
-        if ch == "?":
+        if ch == "'":
+            in_quote = not in_quote
+            out.append(ch)
+        elif ch == "?" and not in_quote:
             n += 1
             out.append(f"${n}")
         else:

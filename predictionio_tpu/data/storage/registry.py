@@ -42,8 +42,11 @@ def register_driver(type_name: str, daos: dict[str, Callable]) -> None:
 
 def _is_postgres_jdbc_url(url: str) -> bool:
     """ONE resolution rule shared by DAO instantiation and `pio status`:
-    a TYPE=jdbc source with a postgres URL maps to the wire driver."""
-    return url.replace("jdbc:", "", 1).startswith(
+    a TYPE=jdbc source with a postgres URL maps to the wire driver.
+
+    Strictly prefix-based: ``replace`` would strip a ``jdbc:`` embedded
+    anywhere in the URL (e.g. inside a query parameter) and misclassify."""
+    return url.removeprefix("jdbc:").startswith(
         ("postgresql://", "postgres://")
     )
 

@@ -38,7 +38,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 from predictionio_tpu.data.batch import Interactions
 from predictionio_tpu.data.bimap import BiMap
@@ -48,6 +47,8 @@ from predictionio_tpu.parallel.mesh import (
     MeshContext,
     device_get_global,
     pad_to_multiple,
+    pcast_varying,
+    shard_map,
 )
 
 logger = logging.getLogger(__name__)
@@ -447,7 +448,7 @@ def _half_step_local(
 
     # carries differ per shard → mark them varying over the mesh axis
     init = jax.tree.map(
-        lambda z: jax.lax.pcast(z, DATA_AXIS, to="varying"),
+        lambda z: pcast_varying(z, DATA_AXIS),
         (
             jnp.zeros((per_shard, rank, rank), jnp.float32),
             jnp.zeros((per_shard, rank), jnp.float32),
@@ -1183,6 +1184,36 @@ class ALSScorer:
 
             self._score = _score
 
+    def enable_fastpath(self, max_k: Optional[int] = None):
+        """AOT-compile the bucketed serving fast path (deploy/reload time).
+
+        Builds a :class:`~predictionio_tpu.serving.fastpath.BucketedScorer`
+        over this model's factors — every bucket rung compiled up front, so
+        no live request ever traces or compiles.  Idempotent and
+        thread-safe; built even when ``on_device`` is False (the batched
+        serve path amortizes the device round trip that makes single
+        queries prefer host).
+        """
+        fp = getattr(self, "_fastpath", None)
+        if fp is None:
+            with self._batch_init_lock:
+                fp = getattr(self, "_fastpath", None)
+                if fp is None:
+                    from predictionio_tpu.serving.fastpath import BucketedScorer
+
+                    fp = BucketedScorer(
+                        self.ctx,
+                        self.model.user_factors,
+                        self.model.item_factors,
+                        max_k=max_k or self.max_k,
+                    )
+                    self._fastpath = fp
+        return fp
+
+    def fastpath_stats(self) -> Optional[dict]:
+        fp = getattr(self, "_fastpath", None)
+        return fp.stats() if fp is not None else None
+
     def recommend_batch(
         self, user_indices: np.ndarray, num: int
     ) -> tuple[np.ndarray, np.ndarray]:
@@ -1194,6 +1225,10 @@ class ALSScorer:
         """
         users = np.asarray(user_indices, np.int64)
         k = min(max(num, 1), self.n_items)
+        fp = getattr(self, "_fastpath", None)
+        if fp is not None and k <= fp.k:
+            idx, vals = fp.score_topk(users, k)
+            return idx, vals
         if self.on_device and k <= self._k:
             if not hasattr(self, "_score_batch"):
                 with self._batch_init_lock:

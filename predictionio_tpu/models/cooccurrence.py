@@ -24,7 +24,6 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from predictionio_tpu.data.batch import Interactions
@@ -33,6 +32,8 @@ from predictionio_tpu.parallel.mesh import (
     MODEL_AXIS,
     MeshContext,
     pad_to_multiple,
+    pcast_varying,
+    shard_map,
 )
 
 _USER_BLOCK = 4096  # users per matmul block (A_b is USER_BLOCK × n_items)
@@ -294,7 +295,7 @@ def cross_occurrence_topn(
 
         C0 = jnp.zeros((p_pad, width_pad), jnp.float32)
         if varying:  # under shard_map the carry differs per model-axis peer
-            C0 = jax.lax.pcast(C0, MODEL_AXIS, to="varying")
+            C0 = pcast_varying(C0, MODEL_AXIS)
         C, _ = jax.lax.scan(body, C0, (pu, pi, pm, su, si, sm))
         return C
 

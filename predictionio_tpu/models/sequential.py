@@ -313,8 +313,9 @@ def _build_sp_loss(mesh, sp_ways: int, cfg: SASRecConfig):
     Numerically identical to the data-parallel `_loss_fn` (tested); use it
     when ``max_len`` at full replication would not fit HBM.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from predictionio_tpu.parallel.mesh import shard_map
 
     from predictionio_tpu.parallel.ring import _ring_attention_block
 

@@ -16,3 +16,20 @@ def top_k_with_mask(scores: jax.Array, k: int, mask: jax.Array | None = None):
     if mask is not None:
         scores = jnp.where(mask, NEG_INF, scores)
     return jax.lax.top_k(scores, k)
+
+
+def gather_score_topk(
+    U: jax.Array, V: jax.Array, u_idx: jax.Array, k: int,
+    item_mask: jax.Array | None = None,
+):
+    """Fused gather→score→top-k: the serving fast-path device program.
+
+    ``U[u_idx] @ V.T`` then masked top-k, all inside one jitted program —
+    the (B, n_items) score matrix lives only as an XLA intermediate and is
+    never materialized on host.  ``item_mask`` is True for slots that must
+    never win (padded item tail, blacklists); it broadcasts over the batch.
+    Returns ``(values (B, k), indices (B, k))``.
+    """
+    scores = U[u_idx] @ V.T  # (B, rank) @ (rank, n_items_pad)
+    mask = item_mask[None, :] if item_mask is not None else None
+    return top_k_with_mask(scores, k, mask=mask)

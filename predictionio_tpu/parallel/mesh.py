@@ -34,6 +34,36 @@ logger = logging.getLogger(__name__)
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
+# -- jax version compatibility ----------------------------------------------
+# shard_map graduated from jax.experimental to the jax namespace (and grew
+# a replication checker fed by jax.lax.pcast) around 0.5.  On older jax the
+# experimental entry point is API-compatible once check_rep is off — which
+# also makes pcast's varying-marking unnecessary, so pcast_varying below is
+# a no-op there.  ONE shim here; every shard_map/pcast user imports it.
+try:
+    from jax import shard_map as _shard_map_new
+
+    shard_map = _shard_map_new
+
+    def pcast_varying(x, axis_name):
+        """Mark ``x`` varying over ``axis_name`` for the rep checker."""
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+except ImportError:  # pre-0.5 jax
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, **kw):
+        # new-jax callers say check_vma; the experimental API calls it
+        # check_rep (same switch: disable the replication checker)
+        kw.setdefault("check_rep", kw.pop("check_vma", False))
+        return _shard_map_exp(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+    def pcast_varying(x, axis_name):
+        """No rep checker without jax.lax.pcast — nothing to mark."""
+        return x
+
 _platform_pinned = False
 
 

@@ -33,10 +33,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.parallel.mesh import MeshContext, pcast_varying, shard_map
 
 NEG_INF = -1e30
 
@@ -75,10 +74,10 @@ def _ring_attention_block(q, k, v, axis_name: str, n_blocks: int, causal: bool,
 
     o0 = jnp.zeros_like(q)
     # constant-initialized carries must be marked varying over the ring axis
-    m0 = jax.lax.pcast(
-        jnp.full(q.shape[:-1], NEG_INF, q.dtype), axis_name, to="varying"
+    m0 = pcast_varying(
+        jnp.full(q.shape[:-1], NEG_INF, q.dtype), axis_name
     )
-    l0 = jax.lax.pcast(jnp.zeros(q.shape[:-1], q.dtype), axis_name, to="varying")
+    l0 = pcast_varying(jnp.zeros(q.shape[:-1], q.dtype), axis_name)
     (o, m, l, _, _), _ = jax.lax.scan(
         body, (o0, m0, l0, k, v), jnp.arange(n_blocks)
     )

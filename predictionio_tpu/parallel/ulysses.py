@@ -33,10 +33,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.parallel.mesh import MeshContext, shard_map
 from predictionio_tpu.parallel.ring import full_attention
 
 

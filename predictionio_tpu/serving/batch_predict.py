@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import json
 import logging
-from typing import Optional
+from typing import Any, Optional
 
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.workflow import (
@@ -30,6 +30,11 @@ from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.serving.query_server import _to_jsonable, bind_query
 
 logger = logging.getLogger(__name__)
+
+# Queries per engine pass: matches the serving fast path's top bucket rung
+# (serving/fastpath.BUCKETS[-1]) so bulk prediction rides the same
+# pre-compiled batched program the query server uses.
+_CHUNK_QUERIES = 64
 
 
 def run_batch_predict(
@@ -66,6 +71,58 @@ def run_batch_predict(
         )
     n = 0
     with open(input_path) as fin, open(output_path, "w") as fout:
+        # Queries are CHUNKED through Algorithm.batch_predict — the same
+        # vectorized one-device-pass entry point the query server's
+        # micro-batcher uses — instead of one predict per line.  Output
+        # order stays line order: a chunk flushes before any out-of-band
+        # (parse-error) line is written.
+        chunk: list[tuple[int, Any, Any]] = []  # (line_no, data, query)
+
+        def write_ok(data, result) -> None:
+            nonlocal n
+            fout.write(
+                json.dumps({"query": data, "prediction": _to_jsonable(result)})
+                + "\n"
+            )
+            n += 1
+
+        def flush() -> None:
+            if not chunk:
+                return
+            try:
+                supplemented = [
+                    (i, serving.supplement(q))
+                    for i, (_, _, q) in enumerate(chunk)
+                ]
+                per_algo = [
+                    dict(a.batch_predict(m, supplemented))
+                    for a, m in zip(algorithms, models)
+                ]
+                for i, (_, data, _q) in enumerate(chunk):
+                    preds = [d[i] for d in per_algo if i in d]
+                    write_ok(data, serving.serve(supplemented[i][1], preds))
+            except Exception as batch_err:
+                # a poisoned chunk falls back to per-line prediction so one
+                # bad query costs one error record, not the whole chunk
+                logger.warning(
+                    "chunk ending at line %d failed (%s); retrying per line",
+                    chunk[-1][0], batch_err,
+                )
+                for line_no, data, q in chunk:
+                    try:
+                        sq = serving.supplement(q)
+                        preds = [
+                            a.predict(m, sq)
+                            for a, m in zip(algorithms, models)
+                        ]
+                        write_ok(data, serving.serve(sq, preds))
+                    except Exception as e:
+                        logger.warning("line %d failed: %s", line_no, e)
+                        fout.write(
+                            json.dumps({"query": data, "error": str(e)}) + "\n"
+                        )
+            chunk.clear()
+
         for line_no, line in enumerate(fin, 1):
             if n_procs > 1 and (line_no - 1) % n_procs != pid:
                 continue
@@ -75,19 +132,13 @@ def run_batch_predict(
             try:
                 data = json.loads(line)
                 query = bind_query(engine.query_cls, data)
-                supplemented = serving.supplement(query)
-                predictions = [
-                    a.predict(m, supplemented) for a, m in zip(algorithms, models)
-                ]
-                result = serving.serve(supplemented, predictions)
-                fout.write(
-                    json.dumps(
-                        {"query": data, "prediction": _to_jsonable(result)}
-                    )
-                    + "\n"
-                )
-                n += 1
             except Exception as e:
                 logger.warning("line %d failed: %s", line_no, e)
+                flush()  # keep output in input-line order
                 fout.write(json.dumps({"query": line, "error": str(e)}) + "\n")
+                continue
+            chunk.append((line_no, data, query))
+            if len(chunk) >= _CHUNK_QUERIES:
+                flush()
+        flush()
     return n, output_path

@@ -1,0 +1,155 @@
+"""Bucketed pre-compiled serving fast path: device-resident score+top-k.
+
+The query server's device work is one fused gather→score→top-k program
+(:func:`predictionio_tpu.ops.topk.gather_score_topk`), but naively jitting
+it per batch size would retrace for every distinct size and pay compile
+latency on live traffic.  This module removes both costs:
+
+* **Bucket ladder** — batches are padded up to a fixed ladder of sizes
+  (:data:`BUCKETS`); the padded tail rows are scored and discarded on host
+  (they cost one extra matmul row each), and the padded ITEM tail is masked
+  inside the program via ``top_k_with_mask``.  Only ``len(BUCKETS)``
+  programs ever exist.
+* **AOT warmup** — every bucket's program is compiled at construction time
+  with ``jax.jit(...).lower(...).compile()`` (deploy/reload, never on a
+  request thread), so no query ever pays trace or compile latency.  Calls
+  go straight to the pre-built executable; a recompile is structurally
+  impossible on the serve path, and :meth:`BucketedScorer.stats` exposes
+  the compile/hit counters that prove it.
+
+The factor matrices are placed replicated on the mesh ONCE and stay
+resident in device memory between queries (Cloudburst's model-next-to-
+compute rule, arXiv:2007.05832); per-call traffic is the (B,) user-index
+upload and the (B, k) result readback.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+from predictionio_tpu.ops.topk import gather_score_topk
+from predictionio_tpu.parallel.mesh import MeshContext, pad_to_multiple
+
+# The batch-size ladder. Powers of two above a singleton lane: 1 serves the
+# trickle case with zero padding, 64 matches MicroBatcher's default
+# max_batch. Tails between rungs pad to the next rung (worst waste: 7 rows
+# at rung 8).
+BUCKETS = (1, 8, 16, 32, 64)
+
+
+def bucket_for(n: int, buckets=BUCKETS) -> Optional[int]:
+    """Smallest ladder rung ≥ n, or None when n overflows the ladder."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return None
+
+
+class BucketedScorer:
+    """Pre-compiled per-bucket score+top-k over device-resident factors."""
+
+    def __init__(
+        self,
+        ctx: MeshContext,
+        user_factors: np.ndarray,
+        item_factors: np.ndarray,
+        max_k: int = 100,
+        buckets=BUCKETS,
+    ):
+        self.ctx = ctx
+        self.n_users = user_factors.shape[0]
+        self.n_items = item_factors.shape[0]
+        self._n_items_pad = pad_to_multiple(self.n_items, 8)
+        self.k = min(max_k, self.n_items)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self._repl = ctx.replicated()
+        pad_i = self._n_items_pad - self.n_items
+        self._U = ctx.replicate(np.asarray(user_factors, np.float32))
+        self._V = ctx.replicate(
+            np.pad(np.asarray(item_factors, np.float32), ((0, pad_i), (0, 0)))
+        )
+        self._item_pad_mask = ctx.replicate(
+            np.arange(self._n_items_pad) >= self.n_items
+        )
+        self._lock = threading.Lock()
+        self.compile_count = 0
+        self.hits: dict[int, int] = {b: 0 for b in self.buckets}
+        self.queries = 0
+        self.padded_rows = 0
+        # AOT warmup: every rung compiled before the first request
+        self._fns = {b: self._compile(b) for b in self.buckets}
+
+    def _compile(self, b: int):
+        """Lower + compile the bucket-b program ahead of time."""
+        k = self.k
+
+        def fn(U, V, item_pad_mask, u_idx):
+            return gather_score_topk(U, V, u_idx, k, item_mask=item_pad_mask)
+
+        dummy_idx = jax.device_put(np.zeros(b, np.int32), self._repl)
+        compiled = (
+            jax.jit(fn)
+            .lower(self._U, self._V, self._item_pad_mask, dummy_idx)
+            .compile()
+        )
+        self.compile_count += 1
+        return compiled
+
+    def score_topk(
+        self, user_indices: np.ndarray, k: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Top-``k`` (indices, values) for every user in ``user_indices``.
+
+        Batches larger than the top rung are served in top-rung chunks, so
+        any size works without growing the compile cache.  ``k`` beyond the
+        compiled width raises ValueError — callers route that to their
+        exact path instead of silently truncating.
+        """
+        if k > self.k:
+            raise ValueError(f"k={k} exceeds compiled top-k width {self.k}")
+        users = np.asarray(user_indices, np.int32)
+        top = self.buckets[-1]
+        idx_parts, val_parts = [], []
+        for s in range(0, len(users), top):
+            chunk = users[s : s + top]
+            b = bucket_for(len(chunk), self.buckets)
+            padded = np.zeros(b, np.int32)
+            padded[: len(chunk)] = chunk
+            u_dev = jax.device_put(padded, self._repl)
+            vals, idx = self._fns[b](self._U, self._V, self._item_pad_mask, u_dev)
+            with self._lock:
+                self.hits[b] += 1
+                self.queries += len(chunk)
+                self.padded_rows += b - len(chunk)
+            # padded tail rows are real top-k rows for user 0 — dropped here
+            idx_parts.append(np.asarray(idx)[: len(chunk), :k])
+            val_parts.append(np.asarray(vals)[: len(chunk), :k])
+        return np.concatenate(idx_parts), np.concatenate(val_parts)
+
+    def stats(self) -> dict:
+        """Counters for ``GET /`` stats and bench artifacts.
+
+        ``compile_count`` only moves at construction (warmup); a nonzero
+        delta across serving traffic IS a recompile and fails the bench's
+        zero-recompile check.
+        """
+        with self._lock:
+            hits = dict(self.hits)
+            return {
+                "buckets": list(self.buckets),
+                "top_k": self.k,
+                "compile_count": self.compile_count,
+                "bucket_hits": {str(b): h for b, h in hits.items()},
+                "calls": sum(hits.values()),
+                "queries": self.queries,
+                "padded_rows": self.padded_rows,
+                "row_occupancy": round(
+                    self.queries / (self.queries + self.padded_rows), 4
+                )
+                if self.queries
+                else None,
+            }

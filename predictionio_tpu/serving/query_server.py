@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import queue
 import secrets
 import threading
 import time
@@ -55,16 +56,25 @@ class EngineServerPlugin:
         return prediction
 
 
+# response-field plans: dataclasses.fields() re-derives the field tuple on
+# every call; a deployed engine serves millions of instances of the SAME
+# few result types, so the names are cached per class after the first walk
+_FIELD_PLANS: dict[type, tuple[str, ...]] = {}
+
+
 def _to_jsonable(obj: Any) -> Any:
-    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+    plan = _FIELD_PLANS.get(type(obj))
+    if plan is not None:
         # None-valued fields are omitted, matching the reference's json4s
         # treatment of Option None (absent field, not null)
         return {
-            k: _to_jsonable(v)
-            for k, v in (
-                (f.name, getattr(obj, f.name)) for f in dataclasses.fields(obj)
-            )
-            if v is not None
+            k: _to_jsonable(v) for k in plan if (v := getattr(obj, k)) is not None
+        }
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        plan = tuple(f.name for f in dataclasses.fields(obj))
+        _FIELD_PLANS[type(obj)] = plan
+        return {
+            k: _to_jsonable(v) for k in plan if (v := getattr(obj, k)) is not None
         }
     if isinstance(obj, (list, tuple)):
         return [_to_jsonable(o) for o in obj]
@@ -127,15 +137,25 @@ class QueryServer:
         self.last_serving_sec = 0.0
         self.latency = LatencyHistogram()
         self.service = HttpService("queryserver")
+        # feedback POSTs ride a bounded background queue, never the request
+        # thread; when the event server can't keep up we drop (and count)
+        # rather than let feedback add to serve latency
+        self._feedback_queue: "queue.Queue[dict]" = queue.Queue(maxsize=256)
+        self._feedback_dropped = 0
+        self._feedback_worker: Optional[threading.Thread] = None
+        # AOT fastpath warmup only pays off where batches actually form; a
+        # plain per-request server (most tests) skips the per-bucket compiles
+        self._warm_fastpath = batching
         self._register_routes()
         self.reload()
         self._batcher = None
         if batching:
+            from predictionio_tpu.serving import fastpath
             from predictionio_tpu.serving.batching import MicroBatcher
 
             self._batcher = MicroBatcher(
                 self._run_query_batch, max_batch=max_batch,
-                window_ms=batch_window_ms,
+                window_ms=batch_window_ms, buckets=fastpath.BUCKETS,
             )
 
     # -- model lifecycle -----------------------------------------------------
@@ -147,6 +167,19 @@ class QueryServer:
         _, algorithms, serving, models = prepare_deploy(
             self.engine, instance, storage=self.storage, ctx=self.ctx
         )
+        if self._warm_fastpath:
+            # pre-compile the serving fast path at deploy/reload so no live
+            # request ever pays trace/compile latency (ISSUE: AOT warmup)
+            for algo, model in zip(algorithms, models):
+                warm = getattr(algo, "warmup", None)
+                if warm is None:
+                    continue
+                try:
+                    warm(model)
+                except Exception:
+                    logger.exception(
+                        "fastpath warmup failed for %s", type(algo).__name__
+                    )
         deployed = _Deployed(
             instance_id=instance.id,
             algorithms=algorithms,
@@ -218,25 +251,49 @@ class QueryServer:
         return result
 
     def _send_feedback(self, query, prediction, pr_id, instance_id) -> None:
-        """Async POST back to the event server (CreateServer.scala:563-569)."""
+        """Async POST back to the event server (CreateServer.scala:563-569).
+
+        Enqueues onto a bounded queue drained by one daemon worker — the
+        request thread never blocks on the event server, and a slow or dead
+        event server drops feedback (counted) instead of backing up serving.
+        """
         if not self.event_server_url:
             return
+        event = {
+            "event": "predict",
+            "entityType": "pio_pr",
+            "entityId": pr_id,
+            "properties": {
+                "engineInstanceId": instance_id,
+                "query": query,
+                "prediction": prediction,
+            },
+        }
+        if self._feedback_worker is None:
+            with self._lock:
+                if self._feedback_worker is None:
+                    self._feedback_worker = threading.Thread(
+                        target=self._feedback_loop,
+                        name="queryserver-feedback",
+                        daemon=True,
+                    )
+                    self._feedback_worker.start()
+        try:
+            self._feedback_queue.put_nowait(event)
+        except queue.Full:
+            with self._lock:
+                self._feedback_dropped += 1
+            logger.warning("feedback queue full; dropping event %s", pr_id)
 
-        def post():
+    def _feedback_loop(self) -> None:
+        url = f"{self.event_server_url}/events.json"
+        if self.access_key:
+            url += f"?accessKey={self.access_key}"
+        while True:
+            event = self._feedback_queue.get()
+            if event is None:  # sentinel from stop()
+                return
             try:
-                event = {
-                    "event": "predict",
-                    "entityType": "pio_pr",
-                    "entityId": pr_id,
-                    "properties": {
-                        "engineInstanceId": instance_id,
-                        "query": query,
-                        "prediction": prediction,
-                    },
-                }
-                url = f"{self.event_server_url}/events.json"
-                if self.access_key:
-                    url += f"?accessKey={self.access_key}"
                 req = urllib.request.Request(
                     url,
                     data=json.dumps(event).encode(),
@@ -246,8 +303,6 @@ class QueryServer:
                 urllib.request.urlopen(req, timeout=5)
             except Exception:
                 logger.exception("feedback POST failed")
-
-        threading.Thread(target=post, daemon=True).start()
 
     # -- routes ----------------------------------------------------------------
     def _register_routes(self):
@@ -267,7 +322,22 @@ class QueryServer:
                     "lastServingSec": self.last_serving_sec,
                     "latency": self.latency.summary(),
                     "feedback": self.feedback,
+                    "feedbackDropped": self._feedback_dropped,
                 }
+                algorithms = d.algorithms if d else []
+                models = d.models if d else []
+            info["batching"] = (
+                self._batcher.stats() if self._batcher is not None else None
+            )
+            fp = []
+            for algo, model in zip(algorithms, models):
+                get_stats = getattr(algo, "serving_stats", None)
+                if get_stats is None:
+                    continue
+                s = get_stats(model)
+                if s is not None:
+                    fp.append(s)
+            info["fastpath"] = fp or None
             return json_response(200, info)
 
         @svc.route("POST", r"/queries\.json")
@@ -324,4 +394,9 @@ class QueryServer:
     def stop(self) -> None:
         if self._batcher is not None:
             self._batcher.stop()
+        if self._feedback_worker is not None:
+            try:
+                self._feedback_queue.put_nowait(None)  # drain-and-exit sentinel
+            except queue.Full:
+                pass  # worker is wedged; it's a daemon thread, let it die
         self.service.stop()

@@ -372,6 +372,18 @@ class ALSAlgorithm(Algorithm):
             self._scorers[id(model)] = scorer
         return scorer
 
+    def warmup(self, model: ALSModel) -> None:
+        """Deploy/reload-time AOT warmup of the bucketed serving fast path
+        (QueryServer calls this for batching deployments): every bucket
+        rung compiles before the first request, so the serve path never
+        traces or compiles on a request thread."""
+        self._scorer(model).enable_fastpath()
+
+    def serving_stats(self, model: ALSModel) -> Optional[dict]:
+        """Fast-path counters for ``GET /`` stats (None until warmup)."""
+        scorer = self._scorers.get(id(model))
+        return scorer.fastpath_stats() if scorer is not None else None
+
     def batch_predict(self, model: ALSModel, queries):
         """Vectorized bulk predict for evaluation (BaseAlgorithm.batchPredict
         parity): filter-free known-user queries score in ONE device pass;

@@ -4,14 +4,22 @@ The p50-predict-latency companion to bench.py's training throughput
 (BASELINE.md headline metrics). Fires N concurrent workers at
 ``/queries.json`` and reports client-side latency quantiles + QPS; the
 server's own histogram (its ``GET /`` route) gives the service-side view.
+
+Each worker holds ONE persistent HTTP/1.1 connection (keep-alive) for its
+whole run — the realistic client shape (SDKs pool connections), and the
+only shape that measures the server rather than the TCP handshake: a
+fresh connect per request adds a connect+thread-spawn tax that dwarfs
+sub-millisecond serve times.  A failed request closes and re-opens the
+worker's connection; the failure is still counted.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import threading
 import time
-import urllib.request
+import urllib.parse
 
 
 def run_loadtest(
@@ -32,6 +40,17 @@ def run_loadtest(
     lock = threading.Lock()
     counter = {"next": 0}
 
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.hostname
+    port = parsed.port or (443 if parsed.scheme == "https" else 80)
+    path = (parsed.path.rstrip("/") or "") + "/queries.json"
+    conn_cls = (
+        http.client.HTTPSConnection
+        if parsed.scheme == "https"
+        else http.client.HTTPConnection
+    )
+    headers = {"Content-Type": "application/json"}
+
     fixed_payload = json.dumps(query).encode()
 
     def payload_for(i: int) -> bytes:
@@ -43,27 +62,30 @@ def run_loadtest(
         return json.dumps(q).encode()
 
     def worker():
-        while True:
-            with lock:
-                if counter["next"] >= requests:
-                    return
-                i = counter["next"]
-                counter["next"] += 1
-            req = urllib.request.Request(
-                f"{url}/queries.json",
-                data=payload_for(i),
-                method="POST",
-                headers={"Content-Type": "application/json"},
-            )
-            t0 = time.perf_counter()
-            try:
-                with urllib.request.urlopen(req, timeout=timeout) as r:
-                    r.read()
+        conn = conn_cls(host, port, timeout=timeout)
+        try:
+            while True:
                 with lock:
-                    latencies.append(time.perf_counter() - t0)
-            except Exception as e:
-                with lock:
-                    errors.append(str(e))
+                    if counter["next"] >= requests:
+                        return
+                    i = counter["next"]
+                    counter["next"] += 1
+                body = payload_for(i)
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", path, body=body, headers=headers)
+                    resp = conn.getresponse()
+                    resp.read()  # drain so the connection can be reused
+                    if resp.status >= 400:
+                        raise RuntimeError(f"HTTP {resp.status}")
+                    with lock:
+                        latencies.append(time.perf_counter() - t0)
+                except Exception as e:
+                    with lock:
+                        errors.append(str(e))
+                    conn.close()  # next request reconnects cleanly
+        finally:
+            conn.close()
 
     threads = [threading.Thread(target=worker) for _ in range(concurrency)]
     t0 = time.perf_counter()

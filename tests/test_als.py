@@ -199,7 +199,7 @@ class TestNumericalEquivalence:
         V_pad = np.zeros((n_items_pad, k), np.float32)
         V_pad[:n_items] = V0
         from functools import partial
-        from jax import shard_map
+        from predictionio_tpu.parallel.mesh import shard_map
         from jax.sharding import PartitionSpec as P
         import jax.numpy as jnp
 
@@ -285,7 +285,7 @@ class TestDenseSolver:
         from functools import partial
 
         import jax.numpy as jnp
-        from jax import shard_map
+        from predictionio_tpu.parallel.mesh import shard_map
         from jax.sharding import PartitionSpec as P
 
         from predictionio_tpu.models import als as als_mod

@@ -170,7 +170,7 @@ WORKER = WORKER_PREAMBLE + """
 from functools import partial
 import numpy as np
 import jax.numpy as jnp
-from jax import shard_map
+from predictionio_tpu.parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 from predictionio_tpu.parallel import distributed
 from predictionio_tpu.parallel.mesh import MeshContext

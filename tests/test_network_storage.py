@@ -443,6 +443,19 @@ class TestJdbcAlias:
         with pytest.raises(StorageError, match="TYPE=postgres"):
             s.get_meta_data_apps()
 
+    def test_postgres_url_detection_is_prefix_based(self):
+        from predictionio_tpu.data.storage.registry import (
+            _is_postgres_jdbc_url,
+        )
+
+        assert _is_postgres_jdbc_url("jdbc:postgresql://db/pio")
+        assert _is_postgres_jdbc_url("postgres://db/pio")
+        # a jdbc: embedded mid-URL must not be stripped into a false match
+        assert not _is_postgres_jdbc_url(
+            "jdbc:mysql://db/pio?fwd=jdbc:postgresql://x"
+        )
+        assert not _is_postgres_jdbc_url("jdbc:mysql://db/pio")
+
 
 class TestServerInfo:
     def test_index_reports_backing_repositories_to_authed(self, served):

@@ -56,7 +56,7 @@ def test_sharded_computation_psum():
 
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from predictionio_tpu.parallel.mesh import shard_map
 
     ctx = MeshContext.create()
     x = np.arange(16, dtype=np.float32)

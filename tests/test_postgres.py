@@ -59,6 +59,14 @@ class TestWireProtocol:
     def test_dollar_translation(self):
         assert _dollar("a = ? AND b IN (?,?)") == "a = $1 AND b IN ($2,$3)"
 
+    def test_dollar_skips_single_quoted_literals(self):
+        """A literal ``?`` inside a string is DATA, never a placeholder."""
+        assert _dollar("a = ? AND b = 'what?'") == "a = $1 AND b = 'what?'"
+        # doubled '' escape toggles quote state twice and round-trips
+        assert (
+            _dollar("a = 'it''s ?' AND b = ?") == "a = 'it''s ?' AND b = $1"
+        )
+
 
 class TestAuth:
     def test_scram_wrong_password_rejected(self, stub):

@@ -103,10 +103,25 @@ def main() -> int:
             "the TPU artifact is untouched", file=sys.stderr,
         )
     primary = artifact["cells"].get("uniform_rebalance", {})
+    # serving trajectory alongside the training metric: the primary cell
+    # runs the http/scorer latency bench; surface its headline numbers at
+    # the top level so round-over-round serving regressions are one grep
+    http = (primary.get("predict_latency_ms") or {}).get("http") or {}
+    serving = {
+        "http_p50_ms": http.get("p50"),
+        "http_p99_ms": http.get("p99"),
+        "qps": http.get("qps"),
+        "batch_occupancy": http.get("batch_occupancy"),
+        "recompiles": http.get("recompiles"),
+    }
+    artifact["serving"] = serving
+    with open(final, "w") as f:
+        json.dump(artifact, f, indent=1)
     print(json.dumps({
         "artifact": final,
         "primary_value": primary.get("value"),
         "on_tpu": all_tpu,
+        **serving,
     }))
     return 0 if all_tpu else 1
 
