@@ -408,6 +408,22 @@ def _http_latency(ctx, dist, n_users, n_items) -> dict:
                 if f.get("row_occupancy") is not None
             ]
             out["batch_occupancy"] = occ[0] if len(occ) == 1 else (occ or None)
+        # resilience layer under a NON-chaos run: every counter must be
+        # quiet — any shed/deadline/degraded/error here is a regression
+        res_stats = after.get("resilience") or {}
+        counters = res_stats.get("counters") or {}
+        out["resilience"] = {
+            "shed": counters.get("shed", 0) + res.get("shed", 0),
+            "deadline_exceeded": counters.get("deadline_exceeded", 0)
+            + res.get("deadlineExceeded", 0),
+            "breaker_open": counters.get("breaker_open", 0),
+            "degraded": counters.get("degraded", 0),
+            "query_errors": counters.get("query_errors", 0),
+            "clean": res["errors"] == 0
+            and counters.get("shed", 0) == 0
+            and counters.get("deadline_exceeded", 0) == 0
+            and counters.get("degraded", 0) == 0,
+        }
         return out
     finally:
         store_mod.set_storage(None)
@@ -594,6 +610,9 @@ def main() -> None:
         record["solver_ab"] = solver_ab
     if latency is not None:
         record["predict_latency_ms"] = latency
+        http_res = (latency.get("http") or {}).get("resilience")
+        if http_res is not None:
+            record["resilience"] = http_res
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

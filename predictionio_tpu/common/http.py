@@ -10,12 +10,15 @@ from __future__ import annotations
 import email.utils
 import json
 import re
+import socket
 import threading
 import time
 import urllib.parse
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
+
+from predictionio_tpu.common import faults as _faults
 
 
 @dataclass
@@ -167,6 +170,34 @@ class HttpService:
                 params = dict(urllib.parse.parse_qsl(parsed.query))
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                # fault-injection shim (chaos tests, common/faults.py):
+                # one None check when no plan is installed
+                act = _faults.check(f"server:{service.name}:{parsed.path}")
+                if act is not None:
+                    if act.latency_s:
+                        time.sleep(act.latency_s)
+                    if act.kind == "drop":
+                        # die without a response: the client sees a reset /
+                        # RemoteDisconnected, like a crashed server process
+                        self.close_connection = True
+                        try:
+                            self.connection.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        return
+                    if act.kind == "error":
+                        try:
+                            self._send(
+                                json_response(
+                                    act.status, {"message": "injected fault"}
+                                )
+                            )
+                        except (BrokenPipeError, ConnectionResetError):
+                            self.close_connection = True
+                        return
+                    if act.kind == "truncate":
+                        # flag for _send: cut a streamed body mid-frame
+                        self._fault_truncate = True
                 req = Request(
                     method=method,
                     path=parsed.path,
@@ -198,7 +229,23 @@ class HttpService:
                     for k, v in resp.headers.items():
                         self.send_header(k, v)
                     self.end_headers()
+                    truncate = getattr(self, "_fault_truncate", False)
                     for piece in body:
+                        if truncate:
+                            # chaos: tear the stream MID-piece (half a frame,
+                            # no terminal chunk) — the client's framed reader
+                            # must surface this as a truncated stream, never
+                            # as a silently-short-but-valid result
+                            cut = piece[: max(1, len(piece) // 2)]
+                            self.wfile.write(
+                                f"{len(cut):x}\r\n".encode() + cut + b"\r\n"
+                            )
+                            self.close_connection = True
+                            try:
+                                self.connection.shutdown(socket.SHUT_RDWR)
+                            except OSError:
+                                pass
+                            return
                         if piece:
                             self.wfile.write(
                                 f"{len(piece):x}\r\n".encode() + piece + b"\r\n"

@@ -38,9 +38,11 @@ flag) checked on every request via the ``X-PIO-Storage-Secret`` header.
 from __future__ import annotations
 
 import datetime as _dt
+import http.client
 import io
 import json
 import logging
+import threading
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -48,6 +50,8 @@ from typing import Any, Optional
 
 import numpy as np
 
+from predictionio_tpu.common import faults as _faults
+from predictionio_tpu.common import resilience
 from predictionio_tpu.common.http import HttpService, Request, Response, json_response
 from predictionio_tpu.data import bimap
 from predictionio_tpu.data.batch import EventBatch, Interactions
@@ -682,12 +686,32 @@ class NetworkStorageError(Exception):
         self.status = status
 
 
+def _retryable(exc: BaseException) -> bool:
+    """Transport faults (no HTTP status) and 5xx retry; 4xx and logical
+    errors propagate — a structurally-bad request never earns a retry."""
+    if isinstance(exc, NetworkStorageError):
+        return exc.status is None or exc.status >= 500
+    return False
+
+
 class _Client:
-    """Shared HTTP plumbing for all network DAOs of one source."""
+    """Shared HTTP plumbing for all network DAOs of one source.
+
+    Every request runs under the resilience policy layer
+    (``common/resilience.py``): jittered-exponential retries with a global
+    retry budget replace ad-hoc one-off retries, and a per-endpoint
+    circuit breaker fails fast while a route is known-dead instead of
+    burning a socket + timeout per call.  Retries are at-least-once:
+    events are idempotent by eventId and meta/model writes are
+    last-writer-wins, so a duplicate delivery is safe.
+    """
 
     def __init__(self, source_name: str = "default", url: Optional[str] = None,
                  secret: Optional[str] = None, timeout: float = 60.0,
-                 chunk_rows: int = 200_000):
+                 chunk_rows: int = 200_000, retries: int = 3,
+                 backoff_ms: float = 50.0, breaker_threshold: int = 5,
+                 breaker_reset_ms: float = 15_000.0,
+                 retry_budget_ratio: float = 0.2):
         if not url:
             raise NetworkStorageError(
                 f"network storage source {source_name!r} needs "
@@ -697,10 +721,55 @@ class _Client:
         self.secret = secret
         # PIO_STORAGE_SOURCES_<N>_TIMEOUT: per-socket-read seconds (chunked
         # pulls reset it per frame); _CHUNK_ROWS: frame size for bulk
-        # scans, 0 = single-body (legacy) wire
+        # scans, 0 = single-body (legacy) wire; _RETRIES/_BACKOFF_MS/
+        # _BREAKER_THRESHOLD/_BREAKER_RESET_MS/_RETRY_BUDGET_RATIO: the
+        # resilience knobs (docs/operations.md "Resilience")
         self.timeout = float(timeout)
         self.chunk_rows = int(chunk_rows)
         self._caps: Optional[frozenset] = None
+        self.policy = resilience.RetryPolicy(
+            max_attempts=max(1, int(retries)),
+            base_backoff_s=float(backoff_ms) / 1e3,
+            budget=resilience.RetryBudget(ratio=float(retry_budget_ratio)),
+        )
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_reset_s = float(breaker_reset_ms) / 1e3
+        self._breakers: dict[str, resilience.CircuitBreaker] = {}
+        self._breakers_lock = threading.Lock()
+        self.retry_count = 0  # total retries performed (observability)
+        self._rl_log = resilience.RateLimitedLogger(logger)
+
+    def breaker_for(self, path: str) -> resilience.CircuitBreaker:
+        """Per-ENDPOINT breaker: '/blob/models/<id>' and '/meta/apps/get'
+        share the health signal of their route, not of the whole server."""
+        endpoint = "/".join(path.split("/")[:3])
+        with self._breakers_lock:
+            br = self._breakers.get(endpoint)
+            if br is None:
+                br = resilience.CircuitBreaker(
+                    endpoint,
+                    failure_threshold=self._breaker_threshold,
+                    reset_timeout_s=self._breaker_reset_s,
+                )
+                self._breakers[endpoint] = br
+            return br
+
+    def _note_retry(self, attempt: int, exc: BaseException) -> None:
+        self.retry_count += 1
+        self._rl_log.warning(
+            "retry", "storage call failed (%s); retry %d", exc, attempt
+        )
+
+    def resilience_stats(self) -> dict:
+        with self._breakers_lock:
+            breakers = {k: b.stats() for k, b in self._breakers.items()}
+        return {
+            "retries": self.retry_count,
+            "retry_budget_tokens": round(self.policy.budget.tokens(), 2)
+            if self.policy.budget
+            else None,
+            "breakers": breakers,
+        }
 
     def capabilities(self) -> frozenset:
         """Wire features the server advertises on ``GET /`` (cached).
@@ -732,6 +801,23 @@ class _Client:
     def _open(self, method: str, path: str, body: Optional[bytes],
               content_type: str):
         """Open the HTTP call; shared error mapping for body+stream paths."""
+        # client-side fault shim (chaos tests): simulate transport faults
+        # deterministically without needing a real broken network
+        act = _faults.check(f"client:storage:{path}")
+        if act is not None:
+            if act.latency_s:
+                import time as _time
+
+                _time.sleep(act.latency_s)
+            if act.kind == "drop":
+                raise NetworkStorageError(
+                    f"storage server unreachable at {self.url}: "
+                    f"injected connection drop"
+                )
+            if act.kind == "error":
+                raise NetworkStorageError(
+                    f"{path}: injected fault", status=act.status
+                )
         headers = {"Content-Type": content_type}
         if self.secret:
             headers[SECRET_HEADER] = self.secret
@@ -755,8 +841,17 @@ class _Client:
 
     def _request(self, method: str, path: str, body: Optional[bytes],
                  content_type: str) -> tuple[bytes, str]:
-        with self._open(method, path, body, content_type) as r:
-            return r.read(), r.headers.get("Content-Type", "")
+        def attempt() -> tuple[bytes, str]:
+            with self._open(method, path, body, content_type) as r:
+                return r.read(), r.headers.get("Content-Type", "")
+
+        return resilience.call_with_resilience(
+            attempt,
+            self.policy,
+            breaker=self.breaker_for(path),
+            retryable=_retryable,
+            on_retry=self._note_retry,
+        )
 
     def call(self, path: str, args: dict) -> Any:
         payload, _ = self._request(
@@ -796,7 +891,15 @@ class _Client:
             def read_exact(n: int, eof_ok: bool = False) -> Optional[bytes]:
                 buf = bytearray()
                 while len(buf) < n:
-                    piece = r.read(n - len(buf))
+                    try:
+                        piece = r.read(n - len(buf))
+                    except (http.client.HTTPException, OSError) as e:
+                        # a connection torn mid-chunk surfaces as
+                        # IncompleteRead/reset; normalize to the structural
+                        # truncation error (status None ⇒ retryable)
+                        raise NetworkStorageError(
+                            f"{path}: truncated frame stream ({e})"
+                        ) from None
                     if not piece:
                         if eof_ok and not buf:
                             return None
@@ -806,10 +909,17 @@ class _Client:
                     buf.extend(piece)
                 return bytes(buf)
 
+            # chaos shim: tear the pull client-side on a seeded schedule
+            fault_site = f"client:storage:frames:{path}"
+
             while True:
                 header = read_exact(8, eof_ok=True)
                 if header is None:
                     return
+                if _faults.check(fault_site) is not None:
+                    raise NetworkStorageError(
+                        f"{path}: truncated frame stream (injected)"
+                    )
                 yield read_exact(int.from_bytes(header, "big"))
 
     def get_binary(self, path: str) -> Optional[bytes]:
@@ -912,20 +1022,34 @@ class NetworkPEvents(base.PEvents):
         # matching — keeps rolling upgrades safe
         if self._c.chunk_rows > 0 and "framed_scan" in self._c.capabilities():
             chunked = dict(wire, chunk_rows=self._c.chunk_rows)
-            try:
+
+            def framed_pull():
                 parts = [
                     batch_from_npz(frame)
                     for frame in self._c.iter_frames("/pevents/find", chunked)
                 ]
                 return _concat_batches(parts)
+
+            try:
+                # the whole pull (not a single socket op) is the retry unit:
+                # a dropped connection or truncated stream re-runs the scan
+                # under the shared policy (backoff, budget, breaker) — the
+                # generalization of the old one-off 400 retry
+                return resilience.call_with_resilience(
+                    framed_pull,
+                    self._c.policy,
+                    breaker=self._c.breaker_for("/pevents/find"),
+                    retryable=_retryable,
+                    on_retry=self._c._note_retry,
+                )
             except NetworkStorageError as e:
                 # one URL can front a mixed fleet mid-rolling-upgrade: the
                 # probe may have hit an upgraded replica while this request
                 # reached a legacy one, which 400s on the unknown chunk_rows
-                # arg. Retry on the legacy wire for exactly that status —
-                # transport faults and 5xx (server down, truncated stream)
-                # propagate immediately rather than silently re-running a
-                # multi-GB scan on the single-body wire
+                # arg. Fall back to the legacy wire for exactly that status —
+                # transport faults and 5xx have already consumed their retry
+                # budget above and propagate rather than silently re-running
+                # a multi-GB scan on the single-body wire
                 if e.status != 400:
                     raise
                 logger.warning(

@@ -40,6 +40,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from predictionio_tpu.common.resilience import Deadline, DeadlineExceeded
+
 logger = logging.getLogger(__name__)
 
 # default ladder mirrors serving/fastpath.BUCKETS without importing jax here
@@ -49,6 +51,7 @@ _DEFAULT_BUCKETS = (1, 8, 16, 32, 64)
 @dataclass
 class _Pending:
     query: Any
+    deadline: Optional[Deadline] = None
     event: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: Optional[BaseException] = None
@@ -90,6 +93,7 @@ class MicroBatcher:
         self._n_batches = 0
         self._n_queries = 0
         self._n_inline = 0
+        self._n_expired = 0  # pendings dropped un-executed (deadline lapsed)
         self._size_hist: collections.Counter = collections.Counter()
         self._wait_s_total = 0.0
         self._worker = threading.Thread(
@@ -97,7 +101,21 @@ class MicroBatcher:
         )
         self._worker.start()
 
-    def submit(self, query: Any, timeout: float = 30.0) -> Any:
+    def submit(
+        self,
+        query: Any,
+        timeout: float = 30.0,
+        deadline: Optional[Deadline] = None,
+    ) -> Any:
+        """Enqueue one query; block until its batch runs or the deadline
+        passes.
+
+        The effective deadline is ``min(request deadline, now + timeout)``
+        and travels WITH the pending: a request whose deadline lapses while
+        queued is dropped at dispatch (never executed on device — the
+        waiter already gave up, running it would burn a device pass on an
+        answer nobody reads) and its waiter gets :class:`DeadlineExceeded`.
+        """
         now = time.perf_counter()
         with self._arr_lock:
             if self._last_arrival is not None:
@@ -106,7 +124,14 @@ class MicroBatcher:
                 gap = min(now - self._last_arrival, self.window_s)
                 self._ewma_gap += self.ALPHA * (gap - self._ewma_gap)
             self._last_arrival = now
-        p = _Pending(query)
+        eff = Deadline.min(deadline, Deadline.after_ms(timeout * 1e3))
+        p = _Pending(query, deadline=eff)
+        if eff.expired():
+            # already over budget at arrival: shed before any queue/device
+            # work (the admission layer normally catches this first)
+            with self._stats_lock:
+                self._n_expired += 1
+            raise DeadlineExceeded("query deadline expired before dispatch")
         # TRICKLE BYPASS: nothing queued and no run in flight — execute the
         # singleton inline on this handler thread.  A lone request then pays
         # exactly the direct-path cost (no worker hop, no window), while
@@ -125,8 +150,11 @@ class MicroBatcher:
                 raise p.error
             return p.result
         self._queue.put(p)
-        if not p.event.wait(timeout):
-            raise TimeoutError("batched query timed out")
+        if not p.event.wait(eff.remaining_s()):
+            # the pending stays queued, but its deadline has passed — the
+            # worker is GUARANTEED to drop it at dispatch (same monotonic
+            # clock), so the device never runs an abandoned query
+            raise DeadlineExceeded("batched query timed out")
         if p.error is not None:
             raise p.error
         return p.result
@@ -146,6 +174,10 @@ class MicroBatcher:
             p.error = RuntimeError("server shutting down")
             p.event.set()
 
+    def depth(self) -> int:
+        """Queued + carried pendings (admission-control signal)."""
+        return self._queue.qsize() + len(self._carry)
+
     def stats(self) -> dict:
         """Per-batch latency/size/occupancy counters (``GET /`` stats)."""
         with self._stats_lock:
@@ -154,6 +186,8 @@ class MicroBatcher:
                 "batches": n_b,
                 "queries": n_q,
                 "inline_batches": self._n_inline,
+                "expired_dropped": self._n_expired,
+                "depth": self.depth(),
                 "avg_batch": round(n_q / n_b, 3) if n_b else None,
                 "batch_sizes": {str(k): v for k, v in sorted(self._size_hist.items())},
                 "avg_window_wait_ms": round(self._wait_s_total / n_b * 1e3, 4)
@@ -224,7 +258,27 @@ class MicroBatcher:
                 self._execute(batch, waited)
 
     def _execute(self, batch: list, waited: float, inline: bool = False) -> None:
-        """Run one batch and deliver results/errors to every waiter."""
+        """Run one batch and deliver results/errors to every waiter.
+
+        Expired pendings are dropped HERE, at dispatch: their waiters have
+        already raised (or are about to), so executing them would spend a
+        device pass on a result nobody will read.
+        """
+        live, expired = [], []
+        for p in batch:
+            if p.deadline is not None and p.deadline.expired():
+                expired.append(p)
+            else:
+                live.append(p)
+        for p in expired:
+            p.error = DeadlineExceeded("query deadline expired in queue")
+            p.event.set()
+        if expired:
+            with self._stats_lock:
+                self._n_expired += len(expired)
+        batch = live
+        if not batch:
+            return
         t_run = time.perf_counter()
         try:
             results = self._run_batch([p.query for p in batch])

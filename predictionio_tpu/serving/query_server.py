@@ -29,7 +29,19 @@ import time
 import urllib.request
 from typing import Any, Optional
 
-from predictionio_tpu.common.http import HttpService, Request, json_response
+from predictionio_tpu.common.http import HttpService, Request, Response, json_response
+from predictionio_tpu.common.resilience import (
+    DEADLINE_HEADER,
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    ErrorCounters,
+    RateLimitedLogger,
+    RetryPolicy,
+    call_with_resilience,
+    parse_deadline_header,
+)
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.workflow import (
     get_latest_completed_instance,
@@ -117,6 +129,9 @@ class QueryServer:
         batching: bool = False,
         max_batch: int = 64,
         batch_window_ms: float = 2.0,
+        max_inflight: int = 256,
+        shed_retry_after_s: float = 1.0,
+        default_deadline_ms: Optional[float] = None,
     ):
         self.engine = engine
         self.storage = storage or Storage.instance()
@@ -143,6 +158,31 @@ class QueryServer:
         self._feedback_queue: "queue.Queue[dict]" = queue.Queue(maxsize=256)
         self._feedback_dropped = 0
         self._feedback_worker: Optional[threading.Thread] = None
+        # -- resilience layer (ISSUE 2): admission control, deadlines,
+        # degraded fallback, counted + rate-limited failure logging
+        self.max_inflight = int(max_inflight)
+        self.shed_retry_after_s = float(shed_retry_after_s)
+        self.default_deadline_ms = default_deadline_ms
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self.counters = ErrorCounters(
+            "shed", "deadline_exceeded", "breaker_open", "degraded",
+            "query_errors", "warmup_errors", "sniffer_errors",
+            "feedback_errors", "reload_failed",
+        )
+        self._rl_log = RateLimitedLogger(logger)
+        # the feedback poster rides the shared retry/breaker policy: a dead
+        # event server trips the breaker and feedback drops fast (counted)
+        # instead of each event burning max_attempts × timeout
+        self._feedback_policy = RetryPolicy(max_attempts=3, base_backoff_s=0.1)
+        self._feedback_breaker = CircuitBreaker(
+            "feedback", failure_threshold=5, reset_timeout_s=15.0
+        )
+        # degraded fallback: the most recent good (jsonable) prediction per
+        # nothing-else-available queries; a scorer/model failure serves this
+        # with {"degraded": true} instead of a 500
+        self._last_good: Optional[dict] = None
+        self._reload_degraded = False
         # AOT fastpath warmup only pays off where batches actually form; a
         # plain per-request server (most tests) skips the per-bucket compiles
         self._warm_fastpath = batching
@@ -160,13 +200,35 @@ class QueryServer:
 
     # -- model lifecycle -----------------------------------------------------
     def reload(self) -> str:
-        """(Re)load the latest COMPLETED instance; atomic swap."""
-        instance = get_latest_completed_instance(
-            self.storage, self.engine_id, self.engine_version, self.engine_variant
-        )
-        _, algorithms, serving, models = prepare_deploy(
-            self.engine, instance, storage=self.storage, ctx=self.ctx
-        )
+        """(Re)load the latest COMPLETED instance; atomic swap.
+
+        Graceful degradation: when a RELOAD fails (storage down, corrupt
+        blob, bad hot-swap) and a previous generation is live, the server
+        KEEPS SERVING the last good generation — counted, flagged on
+        ``/readyz`` and stats — instead of dying or swapping in garbage.
+        The initial deploy still fails loudly: there is nothing to fall
+        back to.
+        """
+        try:
+            instance = get_latest_completed_instance(
+                self.storage, self.engine_id, self.engine_version,
+                self.engine_variant,
+            )
+            _, algorithms, serving, models = prepare_deploy(
+                self.engine, instance, storage=self.storage, ctx=self.ctx
+            )
+        except Exception:
+            with self._lock:
+                last_good = self._deployed
+            if last_good is None:
+                raise  # initial deploy: no generation to degrade to
+            self.counters.inc("reload_failed")
+            self._reload_degraded = True
+            self._rl_log.exception(
+                "reload", "reload failed; serving last good instance %s",
+                last_good.instance_id,
+            )
+            return last_good.instance_id
         if self._warm_fastpath:
             # pre-compile the serving fast path at deploy/reload so no live
             # request ever pays trace/compile latency (ISSUE: AOT warmup)
@@ -177,8 +239,10 @@ class QueryServer:
                 try:
                     warm(model)
                 except Exception:
-                    logger.exception(
-                        "fastpath warmup failed for %s", type(algo).__name__
+                    self.counters.inc("warmup_errors")
+                    self._rl_log.exception(
+                        "warmup", "fastpath warmup failed for %s",
+                        type(algo).__name__,
                     )
         deployed = _Deployed(
             instance_id=instance.id,
@@ -189,6 +253,7 @@ class QueryServer:
         )
         with self._lock:
             self._deployed = deployed
+        self._reload_degraded = False
         logger.info("deployed engine instance %s", instance.id)
         return instance.id
 
@@ -212,23 +277,81 @@ class QueryServer:
             out.append((sq, deployed.serving.serve(sq, preds)))
         return out
 
+    # -- degraded fallback ---------------------------------------------------
+    def _fallback_result(self, query: Any, deployed: _Deployed) -> Optional[dict]:
+        """Best degraded answer when the scorer fails.
+
+        Preference order: an algorithm's own ``fallback_predict`` (e.g. a
+        popularity list computed at train time), else the last good
+        prediction this server produced (stale beats empty for a
+        recommendation surface).  None ⇒ no fallback, caller 500s.
+        """
+        for algo, model in zip(deployed.algorithms, deployed.models):
+            fb = getattr(algo, "fallback_predict", None)
+            if fb is None:
+                continue
+            try:
+                out = _to_jsonable(fb(model, query))
+                if isinstance(out, dict):
+                    return out
+            except Exception:
+                self._rl_log.exception(
+                    "fallback", "fallback_predict failed for %s",
+                    type(algo).__name__,
+                )
+        if self._last_good is not None:
+            return dict(self._last_good)
+        return None
+
     # -- query hot loop (parity: CreateServer.scala:484-634) -----------------
-    def handle_query(self, data: dict) -> dict:
+    def handle_query(
+        self, data: dict, deadline: Optional[Deadline] = None
+    ) -> dict:
         t0 = time.perf_counter()
         with self._lock:
             deployed = self._deployed
         query = bind_query(self.engine.query_cls, data)
-        if self._batcher is not None:
-            supplemented, prediction = self._batcher.submit(query)
-        else:
-            supplemented = deployed.serving.supplement(query)
-            predictions = [
-                algo.predict(model, supplemented)
-                for algo, model in zip(deployed.algorithms, deployed.models)
-            ]
-            prediction = deployed.serving.serve(supplemented, predictions)
+        degraded = False
+        try:
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceeded("deadline expired before predict")
+            if self._batcher is not None:
+                supplemented, prediction = self._batcher.submit(
+                    query, deadline=deadline
+                )
+            else:
+                supplemented = deployed.serving.supplement(query)
+                predictions = [
+                    algo.predict(model, supplemented)
+                    for algo, model in zip(deployed.algorithms, deployed.models)
+                ]
+                prediction = deployed.serving.serve(supplemented, predictions)
+            result = _to_jsonable(prediction)
+        except DeadlineExceeded:
+            self.counters.inc("deadline_exceeded")
+            raise
+        except Exception as e:
+            # scorer/model failure: serve the degraded fallback rather than
+            # a 500 — availability beats freshness for a serving surface
+            fallback = self._fallback_result(query, deployed)
+            if fallback is None:
+                self.counters.inc("query_errors")
+                raise
+            self.counters.inc("degraded")
+            self._rl_log.warning(
+                "degraded", "prediction failed (%s); serving degraded "
+                "fallback", e,
+            )
+            result = fallback
+            result["degraded"] = True
+            supplemented = query
+            degraded = True
+        if not degraded:
+            # remember the newest good answer for the degraded path; shallow
+            # copy so prId/plugin rewrites never leak back into the cache
+            if isinstance(result, dict):
+                self._last_good = dict(result)
         # plugins see JSON values, as in the reference (JValue-based process)
-        result = _to_jsonable(prediction)
         for p in self.plugins:
             if p.plugin_type == EngineServerPlugin.OUTPUT_BLOCKER:
                 result = p.process(supplemented, result, {})
@@ -237,7 +360,10 @@ class QueryServer:
                 try:
                     p.process(supplemented, result, {})
                 except Exception:
-                    logger.exception("sniffer plugin %s failed", p.name)
+                    self.counters.inc("sniffer_errors")
+                    self._rl_log.exception(
+                        "sniffer", "sniffer plugin %s failed", p.name
+                    )
         if self.feedback:
             pr_id = data.get("prId") or secrets.token_hex(8)
             result["prId"] = pr_id
@@ -293,16 +419,30 @@ class QueryServer:
             event = self._feedback_queue.get()
             if event is None:  # sentinel from stop()
                 return
-            try:
+            payload = json.dumps(event).encode()
+
+            def post():
                 req = urllib.request.Request(
                     url,
-                    data=json.dumps(event).encode(),
+                    data=payload,
                     method="POST",
                     headers={"Content-Type": "application/json"},
                 )
                 urllib.request.urlopen(req, timeout=5)
+
+            try:
+                call_with_resilience(
+                    post,
+                    self._feedback_policy,
+                    breaker=self._feedback_breaker,
+                )
+            except BreakerOpen:
+                # event server is down: drop fast (counted) instead of each
+                # event burning max_attempts × timeout behind an open breaker
+                self.counters.inc("breaker_open")
             except Exception:
-                logger.exception("feedback POST failed")
+                self.counters.inc("feedback_errors")
+                self._rl_log.exception("feedback", "feedback POST failed")
 
     # -- routes ----------------------------------------------------------------
     def _register_routes(self):
@@ -338,17 +478,83 @@ class QueryServer:
                 if s is not None:
                     fp.append(s)
             info["fastpath"] = fp or None
+            with self._inflight_lock:
+                inflight = self._inflight
+            info["resilience"] = {
+                "inflight": inflight,
+                "maxInflight": self.max_inflight,
+                "counters": self.counters.snapshot(),
+                "feedbackBreaker": self._feedback_breaker.stats(),
+                "reloadDegraded": self._reload_degraded,
+            }
             return json_response(200, info)
+
+        @svc.route("GET", r"/healthz")
+        def healthz(req: Request):
+            # liveness: the process is up and the route table answers
+            return json_response(200, {"status": "ok"})
+
+        @svc.route("GET", r"/readyz")
+        def readyz(req: Request):
+            # readiness: safe to route traffic here — a model is deployed
+            # and the admission gate has headroom.  reloadDegraded is
+            # reported but does NOT fail readiness: the last good
+            # generation is still serving.
+            with self._lock:
+                deployed = self._deployed is not None
+            with self._inflight_lock:
+                inflight = self._inflight
+            body = {
+                "deployed": deployed,
+                "inflight": inflight,
+                "maxInflight": self.max_inflight,
+                "reloadDegraded": self._reload_degraded,
+            }
+            if not deployed:
+                body["status"] = "no engine instance deployed"
+                return json_response(503, body)
+            if inflight >= self.max_inflight:
+                body["status"] = "overloaded"
+                return json_response(503, body)
+            body["status"] = "ready"
+            return json_response(200, body)
 
         @svc.route("POST", r"/queries\.json")
         def queries(req: Request):
             data = req.json()
             if not isinstance(data, dict):
                 return json_response(400, {"message": "query must be a JSON object"})
+            # admission control: beyond max_inflight, queueing only adds
+            # latency to requests that will miss their deadlines anyway —
+            # shed with 503 + Retry-After so callers back off
+            with self._inflight_lock:
+                if self._inflight >= self.max_inflight:
+                    self.counters.inc("shed")
+                    return Response(
+                        status=503,
+                        body={"message": "server overloaded; request shed"},
+                        headers={"Retry-After": f"{self.shed_retry_after_s:g}"},
+                    )
+                self._inflight += 1
             try:
-                return json_response(200, self.handle_query(data))
-            except TypeError as e:
-                return json_response(400, {"message": str(e)})
+                deadline = parse_deadline_header(req.headers.get(DEADLINE_HEADER))
+                if deadline is None and self.default_deadline_ms is not None:
+                    deadline = Deadline.after_ms(self.default_deadline_ms)
+                if deadline is not None and deadline.expired():
+                    # already over budget on arrival: never touches the device
+                    self.counters.inc("deadline_exceeded")
+                    return json_response(
+                        504, {"message": "deadline expired before execution"}
+                    )
+                try:
+                    return json_response(200, self.handle_query(data, deadline))
+                except DeadlineExceeded as e:
+                    return json_response(504, {"message": str(e)})
+                except TypeError as e:
+                    return json_response(400, {"message": str(e)})
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
 
         @svc.route("GET", r"/reload")
         @svc.route("POST", r"/reload")

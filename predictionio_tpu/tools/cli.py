@@ -601,6 +601,7 @@ def cmd_loadtest(args) -> int:
         requests=args.requests,
         concurrency=args.concurrency,
         samples=samples or None,
+        deadline_ms=args.deadline_ms,
     )
     print(json.dumps(result))
     return 0 if result["errors"] == 0 else 1
@@ -808,6 +809,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--sample", action="append", metavar="FIELD=V1,V2,...",
         help="rotate FIELD through the listed values round-robin, one per "
         "request (mixed-key tail latency instead of one hot payload)",
+    )
+    sp.add_argument(
+        "--deadline-ms", type=float, default=None,
+        help="per-request X-Request-Deadline budget; over-budget requests "
+        "are shed by the server (503/504) and reported separately",
     )
     sp.set_defaults(func=cmd_loadtest)
 

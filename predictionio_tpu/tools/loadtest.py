@@ -29,14 +29,21 @@ def run_loadtest(
     concurrency: int = 8,
     timeout: float = 30.0,
     samples: dict = None,
+    deadline_ms: float = None,
 ) -> dict:
     """``samples`` maps a query FIELD to a list of values; request ``i``
     sends the query with ``field = values[i % len(values)]`` (round-robin,
     deterministic). One fixed payload measures one warm jit path and one
     hot cache line — p50 flatters; mixed keys are what tail latency
-    means. Without ``samples`` the single payload is sent verbatim."""
+    means. Without ``samples`` the single payload is sent verbatim.
+
+    ``deadline_ms`` attaches an ``X-Request-Deadline`` budget to every
+    request; the server sheds (503) or deadline-504s what it can't serve
+    in time, and both are broken out of ``errors`` in the result."""
     latencies: list[float] = []
     errors: list[str] = []
+    shed = [0]  # 503: admission control turned the request away
+    deadline_exceeded = [0]  # 504: budget lapsed before/while serving
     lock = threading.Lock()
     counter = {"next": 0}
 
@@ -50,6 +57,8 @@ def run_loadtest(
         else http.client.HTTPConnection
     )
     headers = {"Content-Type": "application/json"}
+    if deadline_ms is not None:
+        headers["X-Request-Deadline"] = f"{deadline_ms:g}"
 
     fixed_payload = json.dumps(query).encode()
 
@@ -76,6 +85,14 @@ def run_loadtest(
                     conn.request("POST", path, body=body, headers=headers)
                     resp = conn.getresponse()
                     resp.read()  # drain so the connection can be reused
+                    if resp.status == 503:
+                        with lock:
+                            shed[0] += 1
+                        continue  # shed, not broken: connection stays warm
+                    if resp.status == 504:
+                        with lock:
+                            deadline_exceeded[0] += 1
+                        continue
                     if resp.status >= 400:
                         raise RuntimeError(f"HTTP {resp.status}")
                     with lock:
@@ -106,6 +123,8 @@ def run_loadtest(
         "concurrency": concurrency,
         "ok": len(latencies),
         "errors": len(errors),
+        "shed": shed[0],
+        "deadlineExceeded": deadline_exceeded[0],
         "wallSec": round(wall, 3),
         "qps": round(len(latencies) / wall, 1) if wall > 0 else 0.0,
         "p50Ms": round(q(0.50), 3),

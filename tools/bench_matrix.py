@@ -115,6 +115,14 @@ def main() -> int:
         "recompiles": http.get("recompiles"),
     }
     artifact["serving"] = serving
+    # resilience counters from the same loadtest: a NON-chaos bench run
+    # must be clean (zero shed/deadline/degraded) — `clean: false` here is
+    # a regression gate, same grep-ability as the serving block
+    resilience = http.get("resilience") or {
+        "shed": None, "deadline_exceeded": None, "breaker_open": None,
+        "degraded": None, "query_errors": None, "clean": None,
+    }
+    artifact["resilience"] = resilience
     with open(final, "w") as f:
         json.dump(artifact, f, indent=1)
     print(json.dumps({
@@ -122,6 +130,7 @@ def main() -> int:
         "primary_value": primary.get("value"),
         "on_tpu": all_tpu,
         **serving,
+        "resilience": resilience,
     }))
     return 0 if all_tpu else 1
 
