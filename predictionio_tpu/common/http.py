@@ -170,6 +170,10 @@ class HttpService:
                 params = dict(urllib.parse.parse_qsl(parsed.query))
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                # the truncate flag is per-REQUEST, not per-connection: a
+                # keep-alive socket must not carry a stale fault into a
+                # response the seeded plan never scheduled
+                self._fault_truncate = False
                 # fault-injection shim (chaos tests, common/faults.py):
                 # one None check when no plan is installed
                 act = _faults.check(f"server:{service.name}:{parsed.path}")
@@ -231,6 +235,12 @@ class HttpService:
                     self.end_headers()
                     truncate = getattr(self, "_fault_truncate", False)
                     for piece in body:
+                        if not piece:
+                            # skip empties even when tearing: a zero-length
+                            # cut would emit "0\r\n\r\n" — the chunked
+                            # TERMINATOR — turning the injected tear into a
+                            # cleanly-finished empty stream
+                            continue
                         if truncate:
                             # chaos: tear the stream MID-piece (half a frame,
                             # no terminal chunk) — the client's framed reader
@@ -246,10 +256,9 @@ class HttpService:
                             except OSError:
                                 pass
                             return
-                        if piece:
-                            self.wfile.write(
-                                f"{len(piece):x}\r\n".encode() + piece + b"\r\n"
-                            )
+                        self.wfile.write(
+                            f"{len(piece):x}\r\n".encode() + piece + b"\r\n"
+                        )
                     self.wfile.write(b"0\r\n\r\n")
                     return
                 if isinstance(body, bytes):

@@ -215,6 +215,16 @@ class CircuitBreaker:
             self._state = self.CLOSED
             self._probe_inflight = False
 
+    def abort_probe(self) -> None:
+        """Release the half-open probe slot without judging endpoint health.
+
+        A probe that ends in a non-retryable, request-shaped error (an HTTP
+        400 from a legacy replica, say) proves nothing about the endpoint —
+        but the slot must come back, or the breaker wedges in HALF_OPEN
+        rejecting every call forever with no probe able to run."""
+        with self._lock:
+            self._probe_inflight = False
+
     def record_failure(self) -> None:
         with self._lock:
             self._failures += 1
@@ -288,7 +298,11 @@ def call_with_resilience(
         except BaseException as e:
             if not retryable(e):
                 # a structurally-bad request says nothing about endpoint
-                # health: neither a breaker failure nor a retry candidate
+                # health: neither a breaker failure nor a retry candidate —
+                # but if this call held the half-open probe slot it must be
+                # released, or the breaker wedges rejecting all traffic
+                if breaker is not None:
+                    breaker.abort_probe()
                 raise
             if breaker is not None:
                 breaker.record_failure()

@@ -330,6 +330,13 @@ class QueryServer:
         except DeadlineExceeded:
             self.counters.inc("deadline_exceeded")
             raise
+        except TypeError:
+            # malformed query values are a CLIENT bug: surface them through
+            # the route's TypeError → 400 mapping, never mask them behind a
+            # stale degraded 200 (which would also pollute the `degraded`
+            # counter bench.py's clean gate reads as a server regression)
+            self.counters.inc("query_errors")
+            raise
         except Exception as e:
             # scorer/model failure: serve the degraded fallback rather than
             # a 500 — availability beats freshness for a serving surface

@@ -154,6 +154,31 @@ class TestResiliencePrimitives:
         br.record_failure()  # probe failed
         assert br.state == "open" and br.open_count == 2
 
+    def test_halfopen_probe_nonretryable_releases_slot(self):
+        """A probe that dies with a NON-retryable error (HTTP 400 from a
+        legacy replica) must release the half-open probe slot — otherwise
+        the breaker wedges in HALF_OPEN rejecting every call forever."""
+        clock = [0.0]
+        br = CircuitBreaker(
+            "ep", failure_threshold=1, reset_timeout_s=1.0,
+            clock=lambda: clock[0],
+        )
+        br.record_failure()  # trip it
+        clock[0] = 1.5  # cooldown elapsed: next call is the probe
+
+        def bad_request():
+            raise NetworkStorageError("bad", status=400)
+
+        with pytest.raises(NetworkStorageError):
+            call_with_resilience(
+                bad_request, RetryPolicy(max_attempts=3), breaker=br,
+                sleep=lambda s: None,
+            )
+        assert br.state == "half_open"  # health still unjudged...
+        assert br.allow()  # ...but the slot is free: a new probe can run
+        br.record_success()
+        assert br.state == "closed"
+
     def test_retry_budget_caps_amplification(self):
         calls = []
 
@@ -353,6 +378,83 @@ class TestStorageChaos:
         assert pe._c.retry_count >= 1
 
 
+# -- http fault shim: truncate scoping --------------------------------------
+
+
+class TestHttpFaultShim:
+    def _service(self, pieces):
+        from predictionio_tpu.common.http import (
+            HttpService,
+            Response,
+            json_response,
+        )
+
+        svc = HttpService("shim")
+
+        @svc.route("GET", r"/plain")
+        def plain(req):
+            return json_response(200, {"ok": True})
+
+        @svc.route("GET", r"/stream")
+        def stream(req):
+            return Response(status=200, body=iter(pieces))
+
+        port = svc.start("127.0.0.1", 0)
+        return svc, port
+
+    def test_truncate_flag_scoped_to_faulted_request(self):
+        """A truncate fault on a non-streamed response must NOT survive the
+        keep-alive connection and tear a later stream the seeded plan never
+        scheduled."""
+        import http.client
+
+        svc, port = self._service([b"abcd", b"efgh"])
+        try:
+            faults.install(faults.FaultPlan(
+                [_rule(site="server:shim:/plain", kind="truncate", times=1)],
+                seed=6,
+            ))
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            try:
+                conn.request("GET", "/plain")
+                r = conn.getresponse()
+                assert r.status == 200 and r.read()  # non-streamed: unaffected
+                # same keep-alive socket, next request: no fault scheduled
+                conn.request("GET", "/stream")
+                r = conn.getresponse()
+                assert r.read() == b"abcdefgh"  # intact, cleanly terminated
+            finally:
+                conn.close()
+        finally:
+            svc.stop()
+
+    def test_truncate_tears_first_nonempty_piece(self):
+        """An empty leading piece must not turn the injected tear into a
+        cleanly-terminated empty stream: the cut lands on real bytes and the
+        client sees a torn chunked body."""
+        import http.client
+
+        svc, port = self._service([b"", b"payload-bytes"])
+        try:
+            faults.install(faults.FaultPlan(
+                [_rule(site="server:shim:/stream", kind="truncate", times=1)],
+                seed=7,
+            ))
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            try:
+                conn.request("GET", "/stream")
+                r = conn.getresponse()
+                with pytest.raises(
+                    (http.client.IncompleteRead, ConnectionError)
+                ):
+                    r.read()
+            finally:
+                conn.close()
+            assert faults.active().stats()["rules"][0]["fired"] == 1
+        finally:
+            svc.stop()
+
+
 # -- query server: deadlines, shedding, degraded fallback --------------------
 
 
@@ -490,6 +592,31 @@ class TestQueryServerChaos:
                 "POST", base + "/queries.json", {"user": "u1", "num": 2}
             )
             assert status == 200 and "degraded" not in body
+        finally:
+            qs.stop()
+
+    def test_malformed_query_still_400_despite_fallback(self, trained):
+        """TypeError from bad query values is a CLIENT bug: it must map to
+        HTTP 400 even when a degraded fallback is available, never a 200
+        with a stale answer (which would also pollute the degraded gate)."""
+        qs, base = self._server(trained)
+        try:
+            status, _, _ = _call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 2}
+            )
+            assert status == 200  # _last_good is now populated
+            algo = qs._deployed.algorithms[0]
+            algo.predict = lambda m, q: (_ for _ in ()).throw(
+                TypeError("num must be an int")
+            )
+            status, body, _ = _call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 2}
+            )
+            assert status == 400 and "num must be an int" in body["message"]
+            status, info, _ = _call("GET", base + "/")
+            counters = info["resilience"]["counters"]
+            assert counters["degraded"] == 0
+            assert counters["query_errors"] == 1
         finally:
             qs.stop()
 
