@@ -432,6 +432,158 @@ def _http_latency(ctx, dist, n_users, n_items) -> dict:
         memory.reset_store(src)
 
 
+def _ingest_bench() -> dict:
+    """Ingest fast-path evidence on the sqlite backend (the fsync-bound
+    one): per-event-commit baseline vs one-transaction ``insert_batch`` vs
+    the write-behind buffer, all single node, file-backed.
+
+    The headline ``vs_baseline`` is batched/baseline events/s —
+    acceptance wants ≥10x.  The buffer row adds concurrent durable-ack
+    latency (client-observed p50/p99) and the flush batch-size histogram,
+    the group-commit's signature.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from predictionio_tpu.data.api.ingest_buffer import IngestBuffer
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.data.storage.sqlite import close_db
+
+    n = int(os.environ.get("BENCH_INGEST_EVENTS", 3000))
+    # the per-event-commit baseline is ~20-50x slower; cap its share of
+    # wall time without losing measurement stability
+    n_base = int(os.environ.get("BENCH_INGEST_BASELINE_EVENTS", min(n, 1000)))
+    batch_size = int(os.environ.get("BENCH_INGEST_BATCH", 50))
+    tmp = tempfile.mkdtemp(prefix="pio-ingest-bench-")
+    src = "INGESTBENCH"
+    path = os.path.join(tmp, "events.sqlite")
+    base_path = os.path.join(tmp, "events_baseline.sqlite")
+    storage = Storage(env={
+        f"PIO_STORAGE_SOURCES_{src}_TYPE": "sqlite",
+        f"PIO_STORAGE_SOURCES_{src}_PATH": path,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+    })
+    try:
+        le = storage.get_l_events()
+        le.init(1)
+
+        def make_events(tag, count):
+            return [
+                Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"{tag}u{i}", target_entity_type="item",
+                    target_entity_id=f"i{i % 97}",
+                    properties={"rating": float(i % 5 + 1)},
+                )
+                for i in range(count)
+            ]
+
+        # baseline: the pre-batching ingest path — one DAO insert (one
+        # commit) per event, single thread, under the seed's sqlite
+        # config (rollback journal, synchronous=FULL).  The PR moved the
+        # events writer to WAL + synchronous=NORMAL, so the baseline runs
+        # on its own file with the writer pragmas reset to the old values;
+        # otherwise the comparison would hide the durability-config win.
+        base_storage = Storage(env={
+            f"PIO_STORAGE_SOURCES_{src}_TYPE": "sqlite",
+            f"PIO_STORAGE_SOURCES_{src}_PATH": base_path,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+        })
+        from predictionio_tpu.data.storage.sqlite import (
+            _INSERT_EVENT_SQL, _event_row, new_event_id,
+        )
+
+        base_le = base_storage.get_l_events()
+        base_le.init(1)
+        bconn, block = base_le.conn, base_le.lock  # the shared DAO conn
+        bconn.execute("PRAGMA synchronous=FULL")
+        evs = make_events("base", n_base)
+        t0 = time.perf_counter()
+        for e in evs:
+            row = _event_row(e, e.event_id or new_event_id(), 1, None)
+            with block:
+                bconn.execute(_INSERT_EVENT_SQL, row)
+                bconn.commit()
+        base_dt = time.perf_counter() - t0
+        baseline = n_base / base_dt
+
+        # batched: insert_batch in endpoint-sized chunks, single thread
+        evs = make_events("batch", n)
+        t0 = time.perf_counter()
+        for s in range(0, n, batch_size):
+            le.insert_batch(evs[s:s + batch_size], 1)
+        batch_dt = time.perf_counter() - t0
+        batched = n / batch_dt
+
+        # write-behind: concurrent producers, durable ack (wait for the
+        # group commit); per-event ack latency is the client-visible cost
+        buf = IngestBuffer(le, flush_ms=2.0, durable_ack=True)
+        evs = make_events("buf", n)
+        # each durable-ack producer has one event in flight, so the flush
+        # coalesces ~`workers` events per commit — concurrency IS the
+        # group-commit batch size
+        workers = int(os.environ.get("BENCH_INGEST_WORKERS", 32))
+        acks: list[float] = []
+        ack_lock = threading.Lock()
+
+        def producer(w):
+            local = []
+            for e in evs[w::workers]:
+                t0 = time.perf_counter()
+                if not buf.submit(e, 1).wait(30.0):
+                    raise RuntimeError("ingest buffer ack timed out")
+                local.append(time.perf_counter() - t0)
+            with ack_lock:
+                acks.extend(local)
+
+        threads = [
+            threading.Thread(target=producer, args=(w,)) for w in range(workers)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        buf_dt = time.perf_counter() - t0
+        buf_stats = buf.stats()
+        buf.close()
+        acks.sort()
+        q = lambda p: round(
+            acks[min(int(p * len(acks)), len(acks) - 1)] * 1e3, 3
+        )
+        return {
+            "backend": "sqlite",
+            "events": n,
+            "batch_size": batch_size,
+            "baseline_events": n_base,
+            "baseline_config": "per-event commit, rollback journal, synchronous=FULL",
+            "baseline_events_per_sec": round(baseline, 1),
+            "batched_events_per_sec": round(batched, 1),
+            # the acceptance ratio: batched DAO path vs per-event commits
+            "vs_baseline": round(batched / baseline, 2),
+            "buffered_events_per_sec": round(n / buf_dt, 1),
+            "buffered_vs_baseline": round(n / buf_dt / baseline, 2),
+            "ack_p50_ms": q(0.50),
+            "ack_p99_ms": q(0.99),
+            "flushes": buf_stats["flushes"],
+            "avg_flush_batch": buf_stats["avg_flush_batch"],
+            "flush_batch_hist": buf_stats["flush_batch_hist"],
+            "flush_errors": buf_stats["flush_errors"],
+        }
+    finally:
+        try:
+            close_db(path)
+            close_db(base_path)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     # BENCH_PLATFORM=cpu skips the (slow) tunnel probe for local iteration
     forced_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
@@ -583,6 +735,14 @@ def main() -> None:
             http_lat = {"error": str(e)}
         print(f"INFO: http latency: {http_lat}", file=sys.stderr)
         latency = {"scorer": scorer_lat, "http": http_lat}
+    ingest = None
+    if os.environ.get("BENCH_INGEST", "1") != "0":
+        try:
+            ingest = _ingest_bench()
+        except Exception as e:  # ingest bench must never kill the artifact
+            print(f"WARNING: ingest bench failed: {e}", file=sys.stderr)
+            ingest = {"error": str(e)}
+        print(f"INFO: ingest: {ingest}", file=sys.stderr)
     record = {
         "metric": "als_train_events_per_sec_per_chip",
         "value": round(value, 1),
@@ -613,6 +773,8 @@ def main() -> None:
         http_res = (latency.get("http") or {}).get("resilience")
         if http_res is not None:
             record["resilience"] = http_res
+    if ingest is not None:
+        record["ingest"] = ingest
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

@@ -21,9 +21,11 @@ from __future__ import annotations
 
 import base64
 import logging
+import os
 from typing import Optional
 
 from predictionio_tpu.common.http import HttpService, Request, Response, json_response
+from predictionio_tpu.data.api.ingest_buffer import BufferFull, IngestBuffer
 from predictionio_tpu.data.api.stats import Stats
 from predictionio_tpu.data.event import Event, parse_time_or_none
 from predictionio_tpu.data.storage.registry import Storage
@@ -36,7 +38,14 @@ from predictionio_tpu.data.webhooks.connector import (
 
 logger = logging.getLogger(__name__)
 
-MAX_BATCH_SIZE = 50  # parity: EventServer.scala:66
+MAX_BATCH_SIZE = 50  # parity default: EventServer.scala:66
+
+
+def _env_num(name: str, default, cast):
+    try:
+        return cast(os.environ[name])
+    except (KeyError, ValueError, TypeError):
+        return default
 
 
 class EventServerPlugin:
@@ -58,11 +67,41 @@ class EventServer:
         storage: Optional[Storage] = None,
         stats: bool = False,
         plugins: Optional[list[EventServerPlugin]] = None,
+        ingest_mode: Optional[str] = None,
+        ingest_flush_ms: Optional[float] = None,
+        ingest_buffer_max: Optional[int] = None,
     ):
         self.storage = storage or Storage.instance()
         self.stats_enabled = stats
         self.stats = Stats()
         self.plugins = list(plugins or [])
+        # env knob, read at construction: the parity limit (50) stays the
+        # default; deployments raise it per docs/operations.md "Ingestion"
+        self.max_batch_size = _env_num("PIO_MAX_BATCH_SIZE", MAX_BATCH_SIZE, int)
+        # opt-in group-commit write-behind for single-event POSTs
+        # (docs/operations.md "Ingestion"): off | durable | fast
+        mode = ingest_mode if ingest_mode is not None else os.environ.get(
+            "PIO_INGEST_BUFFER", "off"
+        )
+        if mode not in ("off", "durable", "fast"):
+            raise ValueError(
+                f"ingest mode must be off|durable|fast, got {mode!r}"
+            )
+        self.ingest_mode = mode
+        self.ingest_buffer: Optional[IngestBuffer] = None
+        if mode != "off":
+            self.ingest_buffer = IngestBuffer(
+                self.storage.get_l_events(),
+                flush_ms=(
+                    ingest_flush_ms if ingest_flush_ms is not None
+                    else _env_num("PIO_INGEST_FLUSH_MS", 5.0, float)
+                ),
+                buffer_max=(
+                    ingest_buffer_max if ingest_buffer_max is not None
+                    else _env_num("PIO_INGEST_BUFFER_MAX", 10_000, int)
+                ),
+                durable_ack=(mode == "durable"),
+            )
         self.service = HttpService("eventserver")
         self._register_routes()
 
@@ -132,6 +171,126 @@ class EventServer:
             return json_response(400, {"message": str(e)})
         return self._insert_event(auth, event)
 
+    def _insert_buffered(self, auth: dict, data: dict) -> Response:
+        """Single-event POST through the write-behind buffer: validation
+        and plugins run inline (a rejected event is never buffered), the
+        commit is coalesced with its neighbors' by the flusher."""
+        try:
+            event = Event.from_dict(data)
+        except (ValueError, KeyError, TypeError) as e:
+            self.stats_update(auth, str(data.get("event", "")), 400)
+            return json_response(400, {"message": str(e)})
+        denied = self._check_event_allowed(auth, event.event)
+        if denied is None:
+            denied = self._run_plugins(event, auth)
+        if denied is not None:
+            self.stats_update(auth, event.event, denied.status)
+            return denied
+        try:
+            ticket = self.ingest_buffer.submit(
+                event, auth["app_id"], auth["channel_id"]
+            )
+        except BufferFull as e:
+            # backpressure is visible: the PR 2 shedding contract
+            self.stats_update(auth, event.event, 503)
+            return Response(
+                503,
+                {"message": "ingest buffer full; retry later"},
+                headers={"Retry-After": f"{max(e.retry_after_s, 1e-3):g}"},
+            )
+        if not self.ingest_buffer.durable_ack:
+            # fast-ack: buffered, not yet committed — 202, honestly
+            self.stats_update(auth, event.event, 202)
+            return json_response(202, {"eventId": ticket.event_id})
+        if not ticket.wait(30.0):
+            self.stats_update(auth, event.event, 503)
+            return Response(
+                503,
+                {"message": "ingest flush timed out; retry later"},
+                headers={"Retry-After": "1"},
+            )
+        if ticket.error is not None:
+            self.stats_update(auth, event.event, 500)
+            return json_response(500, {"message": str(ticket.error)})
+        self.stats_update(auth, event.event, 201)
+        return json_response(201, {"eventId": ticket.event_id})
+
+    def _insert_batch(self, auth: dict, items: list) -> list[dict]:
+        """The vectorized batch path: decode + validate every item in one
+        pass (auth already done once for the request), run plugins exactly
+        once per event, then write each (app, channel) group with ONE
+        ``insert_batch`` DAO call — while keeping the reference's per-item
+        partial-success statuses bit-for-bit.
+        """
+        results: list[Optional[dict]] = [None] * len(items)
+        pending: list[tuple[int, Event]] = []
+        # the ACL verdict depends only on the event NAME: compute it once
+        # per distinct name instead of once per item
+        acl: dict[str, Optional[Response]] = {}
+        for i, item in enumerate(items):
+            if not isinstance(item, dict):
+                results[i] = {"status": 400, "message": "not a JSON object"}
+                continue
+            try:
+                event = Event.from_dict(item)
+            except (ValueError, KeyError, TypeError) as e:
+                self.stats_update(auth, str(item.get("event", "")), 400)
+                results[i] = {"status": 400, "message": str(e)}
+                continue
+            if event.event not in acl:
+                acl[event.event] = self._check_event_allowed(auth, event.event)
+            denied = acl[event.event]
+            if denied is None:
+                # plugins see every admitted event exactly once; blockers
+                # still veto per item
+                denied = self._run_plugins(event, auth)
+            if denied is not None:
+                self.stats_update(auth, event.event, denied.status)
+                entry = dict(denied.body)
+                entry["status"] = denied.status
+                results[i] = entry
+                continue
+            pending.append((i, event))
+        if not pending:
+            return results
+        le = self.storage.get_l_events()
+        # today auth is request-scoped so all items share one (app,
+        # channel); grouping keys the write anyway so per-item routing
+        # slots in without touching the flow
+        groups: dict[tuple, list[tuple[int, Event]]] = {}
+        for i, event in pending:
+            groups.setdefault(
+                (auth["app_id"], auth["channel_id"]), []
+            ).append((i, event))
+        for (app_id, channel_id), group in groups.items():
+            le.init(app_id, channel_id)
+            events = [e for _, e in group]
+            try:
+                ids = le.insert_batch(events, app_id, channel_id)
+            except Exception as e:
+                # batched write failed (poison event, storage fault):
+                # degrade to per-item inserts so good items still land —
+                # partial success is the endpoint's contract
+                logger.warning(
+                    "insert_batch failed (%s); retrying items singly", e
+                )
+                ids = None
+            if ids is not None:
+                for (i, event), eid in zip(group, ids):
+                    self.stats_update(auth, event.event, 201)
+                    results[i] = {"eventId": eid, "status": 201}
+                continue
+            for i, event in group:
+                try:
+                    eid = le.insert(event, app_id, channel_id)
+                except Exception as e:
+                    self.stats_update(auth, event.event, 500)
+                    results[i] = {"status": 500, "message": str(e)}
+                else:
+                    self.stats_update(auth, event.event, 201)
+                    results[i] = {"eventId": eid, "status": 201}
+        return results
+
     def _insert_event(self, auth: dict, event: Event) -> Response:
         denied = self._check_event_allowed(auth, event.event)
         if denied is None:
@@ -165,6 +324,8 @@ class EventServer:
             data = req.json()
             if not isinstance(data, dict):
                 return json_response(400, {"message": "request body must be a JSON object"})
+            if self.ingest_buffer is not None:
+                return self._insert_buffered(auth, data)
             return self._insert(auth, data)
 
         @svc.route("GET", r"/events\.json")
@@ -235,31 +396,32 @@ class EventServer:
 
         @svc.route("POST", r"/batch/events\.json")
         def batch_events(req):
-            # partial-success semantics (parity: EventServer.scala:340-419)
+            # partial-success semantics (parity: EventServer.scala:340-419);
+            # one auth + one grouped insert_batch, per-item statuses
             auth, err = self._authenticate(req)
             if err:
                 return err
             data = req.json()
             if not isinstance(data, list):
                 return json_response(400, {"message": "request body must be a JSON array"})
-            if len(data) > MAX_BATCH_SIZE:
+            if len(data) > self.max_batch_size:
                 return json_response(
                     400,
                     {
                         "message": f"Batch request must have less than or equal to "
-                        f"{MAX_BATCH_SIZE} events"
+                        f"{self.max_batch_size} events"
                     },
                 )
-            results = []
-            for item in data:
-                if not isinstance(item, dict):
-                    results.append({"status": 400, "message": "not a JSON object"})
-                    continue
-                r = self._insert(auth, item)
-                entry = dict(r.body)
-                entry["status"] = r.status
-                results.append(entry)
-            return json_response(200, results)
+            return json_response(200, self._insert_batch(auth, data))
+
+        @svc.route("GET", r"/ingest/stats\.json")
+        def ingest_stats(req):
+            auth, err = self._authenticate(req)
+            if err:
+                return err
+            if self.ingest_buffer is None:
+                return json_response(200, {"mode": "off"})
+            return json_response(200, self.ingest_buffer.stats())
 
         @svc.route("GET", r"/stats\.json")
         def stats_route(req):
@@ -325,7 +487,11 @@ class EventServer:
         return actual
 
     def stop(self) -> None:
+        # stop accepting first, then drain the buffer: every acked event
+        # is flushed before shutdown returns
         self.service.stop()
+        if self.ingest_buffer is not None:
+            self.ingest_buffer.close()
 
 
 def register_builtin_connectors() -> None:

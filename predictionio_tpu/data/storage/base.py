@@ -147,10 +147,34 @@ class LEvents(abc.ABC):
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         """Insert one event, returning its eventId."""
 
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> list[str]:
+        """Insert many events in one DAO call, returning their eventIds in
+        input order (the ingest fast path: one transaction / round trip per
+        batch, not per event).
+
+        Contract every driver upholds:
+
+        * returned ids align positionally with ``events``; pre-set
+          ``event_id`` values are preserved, missing ones are assigned.
+        * the batch is atomic per (app, channel) namespace where the
+          backend can express it (sqlite: one transaction; memory: one
+          lock hold; network: one request). A failure raises and callers
+          may safely re-submit the SAME events — inserts are idempotent
+          by eventId on replayable drivers.
+        * an empty sequence is a no-op returning ``[]``.
+
+        Default implementation loops :meth:`insert` (correct everywhere,
+        fast nowhere).
+        """
+        return [self.insert(e, app_id, channel_id) for e in events]
+
     def batch_insert(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
     ) -> list[str]:
-        return [self.insert(e, app_id, channel_id) for e in events]
+        """Back-compat alias: drivers implement :meth:`insert_batch`."""
+        return self.insert_batch(events, app_id, channel_id)
 
     @abc.abstractmethod
     def get(

@@ -127,6 +127,21 @@ class MemoryLEvents(base.LEvents):
             ns[eid] = event.with_id(eid)
         return eid
 
+    def insert_batch(self, events, app_id, channel_id=None):
+        # ids + rows materialized BEFORE the lock: a bad event (id
+        # assignment, with_id) fails the whole batch with nothing written,
+        # and the store lock is held for one dict-update, not N inserts
+        ids = []
+        rows = {}
+        for event in events:
+            eid = event.event_id or new_event_id()
+            ids.append(eid)
+            rows[eid] = event.with_id(eid)
+        with self._s.lock:
+            ns = self._s.events.setdefault(_key(app_id, channel_id), {})
+            ns.update(rows)
+        return ids
+
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None):
         with self._s.lock:
             return self._s.events.get(_key(app_id, channel_id), {}).get(event_id)

@@ -361,10 +361,12 @@ class StorageServer:
             if method == "insert":
                 e = Event.from_dict(args["event"])
                 return json_response(200, {"result": le.insert(e, app_id, channel_id)})
-            if method == "batch_insert":
+            if method in ("insert_batch", "batch_insert"):
+                # one framed request per batch (not N round trips); the
+                # legacy wire name keeps pre-rename clients served
                 evs = [Event.from_dict(d) for d in args["events"]]
                 return json_response(
-                    200, {"result": le.batch_insert(evs, app_id, channel_id)}
+                    200, {"result": le.insert_batch(evs, app_id, channel_id)}
                 )
             if method == "get":
                 e = le.get(args["event_id"], app_id, channel_id)
@@ -956,11 +958,20 @@ class NetworkLEvents(base.LEvents):
     def insert(self, event, app_id, channel_id=None):
         return self._call("insert", app_id, channel_id, event=event.to_dict())
 
-    def batch_insert(self, events, app_id, channel_id=None):
-        return self._call(
-            "batch_insert", app_id, channel_id,
-            events=[e.to_dict() for e in events],
-        )
+    def insert_batch(self, events, app_id, channel_id=None):
+        # the whole batch travels as ONE request; a pre-rename server
+        # doesn't know the route name, so fall back to the legacy wire
+        # method (capabilities-style rolling-upgrade contract)
+        events = list(events)
+        if not events:
+            return []
+        wire = [e.to_dict() for e in events]
+        try:
+            return self._call("insert_batch", app_id, channel_id, events=wire)
+        except NetworkStorageError as e:
+            if e.status != 404:
+                raise
+            return self._call("batch_insert", app_id, channel_id, events=wire)
 
     def get(self, event_id, app_id, channel_id=None):
         d = self._call("get", app_id, channel_id, event_id=event_id)

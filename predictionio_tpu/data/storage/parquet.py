@@ -588,7 +588,7 @@ class ParquetLEvents(base.LEvents):
         ns.compact()  # stat()-gated; folds the WAL once it crosses the size trigger
         return eid
 
-    def batch_insert(self, events, app_id, channel_id=None):
+    def insert_batch(self, events, app_id, channel_id=None):
         ids = []
         ops = []
         for event in events:

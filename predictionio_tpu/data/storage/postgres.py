@@ -620,9 +620,11 @@ class PostgresLEvents(_PgDAO, base.LEvents):
 
     def insert(self, event, app_id, channel_id=None):
         event_id = event.event_id or new_event_id()
+        # ON CONFLICT DO NOTHING: re-submitting an id-bearing event (a
+        # retried ingest flush) must be idempotent, not a PK violation
         self._exec(
             f"INSERT INTO events ({_EVENT_COLS}) "
-            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?) ON CONFLICT DO NOTHING",
             (
                 event_id, app_id, _chan(channel_id), event.event,
                 event.entity_type, event.entity_id,
@@ -634,7 +636,7 @@ class PostgresLEvents(_PgDAO, base.LEvents):
         )
         return event_id
 
-    def batch_insert(self, events, app_id, channel_id=None):
+    def insert_batch(self, events, app_id, channel_id=None):
         """Multi-row VALUES inserts (chunks of 256): one wire round trip
         per chunk instead of one per event — the event server's batch of
         50 costs one RTT, not 50 serialized ones under the shared lock."""
@@ -654,8 +656,12 @@ class PostgresLEvents(_PgDAO, base.LEvents):
                     _ts(e.creation_time),
                 ))
             values = ",".join(["(" + ",".join("?" * 13) + ")"] * len(chunk))
+            # idempotent by (id, app_id, channel_id): a retried flush
+            # re-writes the same rows instead of failing the whole batch
             self._exec(
-                f"INSERT INTO events ({_EVENT_COLS}) VALUES {values}", params
+                f"INSERT INTO events ({_EVENT_COLS}) VALUES {values} "
+                "ON CONFLICT DO NOTHING",
+                params,
             )
         return ids
 

@@ -443,6 +443,9 @@ def cmd_eventserver(args) -> int:
     es = EventServer(
         storage=_storage(), stats=args.stats,
         plugins=load_plugins(args.plugin, group=EVENT_GROUP),
+        ingest_mode=args.ingest_buffer,
+        ingest_flush_ms=args.flush_ms,
+        ingest_buffer_max=args.buffer_max,
     )
     port = es.start(args.ip, args.port, cert_path=args.cert_path,
                     key_path=args.key_path)
@@ -584,8 +587,23 @@ def cmd_instances(args) -> int:
 
 
 def cmd_loadtest(args) -> int:
-    from predictionio_tpu.tools.loadtest import run_loadtest
+    from predictionio_tpu.tools.loadtest import run_ingest_loadtest, run_loadtest
 
+    if args.events:
+        # ingest mode: hammer a live Event Server instead of a query server
+        if not args.access_key:
+            print("[ERROR] --events mode needs --access-key")
+            return 1
+        result = run_ingest_loadtest(
+            url=f"http://{args.ip}:{args.port}",
+            access_key=args.access_key,
+            events=args.events,
+            concurrency=args.concurrency,
+            batch_size=args.batch_size,
+            channel=args.channel,
+        )
+        print(json.dumps(result))
+        return 0 if result["errors"] == 0 else 1
     samples = {}
     for spec in args.sample or []:
         field, _, vals = spec.partition("=")
@@ -761,6 +779,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--plugin", action="append", default=[])
     sp.add_argument("--cert-path", default=None)
     sp.add_argument("--key-path", default=None)
+    sp.add_argument(
+        "--ingest-buffer", choices=["off", "durable", "fast"], default=None,
+        help="group-commit write-behind for single-event POSTs "
+        "(default: PIO_INGEST_BUFFER env or off)",
+    )
+    sp.add_argument("--flush-ms", type=float, default=None,
+                    help="write-behind flush interval (PIO_INGEST_FLUSH_MS)")
+    sp.add_argument("--buffer-max", type=int, default=None,
+                    help="write-behind capacity; beyond it single-event "
+                    "POSTs shed 503 (PIO_INGEST_BUFFER_MAX)")
     sp.set_defaults(func=cmd_eventserver)
 
     sp = sub.add_parser("storageserver")
@@ -815,6 +843,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="per-request X-Request-Deadline budget; over-budget requests "
         "are shed by the server (503/504) and reported separately",
     )
+    sp.add_argument(
+        "--events", type=int, default=None,
+        help="ingest mode: POST this many events at an Event Server "
+        "(reports events/s + ack p50/p99) instead of querying",
+    )
+    sp.add_argument("--access-key", default=None,
+                    help="access key for --events mode")
+    sp.add_argument(
+        "--batch-size", type=int, default=1,
+        help="--events mode: events per request (1 = /events.json, "
+        ">1 = /batch/events.json)",
+    )
+    sp.add_argument("--channel", default=None,
+                    help="--events mode: target channel name")
     sp.set_defaults(func=cmd_loadtest)
 
     sub.add_parser("upgrade").set_defaults(func=cmd_upgrade)

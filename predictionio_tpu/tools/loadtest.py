@@ -131,3 +131,132 @@ def run_loadtest(
         "p90Ms": round(q(0.90), 3),
         "p99Ms": round(q(0.99), 3),
     }
+
+
+def run_ingest_loadtest(
+    url: str,
+    access_key: str,
+    events: int = 1000,
+    concurrency: int = 8,
+    batch_size: int = 1,
+    timeout: float = 30.0,
+    event_template: dict = None,
+    channel: str = None,
+) -> dict:
+    """Ingest-side load test: POST events at a live Event Server.
+
+    ``batch_size=1`` drives ``POST /events.json`` (one event per request
+    — the write-behind buffer's shape); larger sizes drive
+    ``POST /batch/events.json`` with ``batch_size`` events per request
+    (the vectorized endpoint's shape).  Entity ids rotate per event so the
+    workload isn't one hot row.  Latency quantiles are per-REQUEST ack
+    times; ``eventsPerSec`` is the headline ingest throughput.  503s count
+    as ``shed`` (buffer backpressure), not errors, mirroring
+    :func:`run_loadtest`.
+    """
+    template = dict(event_template or {
+        "event": "rate",
+        "entityType": "user",
+        "targetEntityType": "item",
+        "properties": {"rating": 5},
+    })
+    batch_size = max(1, int(batch_size))
+    n_requests = (events + batch_size - 1) // batch_size
+
+    latencies: list[float] = []
+    errors: list[str] = []
+    shed = [0]
+    acked = [0]
+    lock = threading.Lock()
+    counter = {"next": 0}
+
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.hostname
+    port = parsed.port or (443 if parsed.scheme == "https" else 80)
+    qs = urllib.parse.urlencode(
+        {"accessKey": access_key, **({"channel": channel} if channel else {})}
+    )
+    path = (parsed.path.rstrip("/") or "") + (
+        "/batch/events.json" if batch_size > 1 else "/events.json"
+    ) + "?" + qs
+    conn_cls = (
+        http.client.HTTPSConnection
+        if parsed.scheme == "https"
+        else http.client.HTTPConnection
+    )
+    headers = {"Content-Type": "application/json"}
+
+    def payload_for(i: int) -> tuple[bytes, int]:
+        lo = i * batch_size
+        n = min(batch_size, events - lo)
+        items = [
+            dict(template, entityId=f"u{lo + j}", targetEntityId=f"i{(lo + j) % 97}")
+            for j in range(n)
+        ]
+        body = items if batch_size > 1 else items[0]
+        return json.dumps(body).encode(), n
+
+    def worker():
+        conn = conn_cls(host, port, timeout=timeout)
+        try:
+            while True:
+                with lock:
+                    if counter["next"] >= n_requests:
+                        return
+                    i = counter["next"]
+                    counter["next"] += 1
+                body, n = payload_for(i)
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", path, body=body, headers=headers)
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                    if resp.status == 503:
+                        with lock:
+                            shed[0] += 1
+                        continue
+                    if resp.status >= 400:
+                        raise RuntimeError(f"HTTP {resp.status}")
+                    ok_items = n
+                    if batch_size > 1:
+                        ok_items = sum(
+                            1 for r in json.loads(raw.decode())
+                            if r.get("status") in (201, 202)
+                        )
+                    with lock:
+                        latencies.append(time.perf_counter() - t0)
+                        acked[0] += ok_items
+                except Exception as e:
+                    with lock:
+                        errors.append(str(e))
+                    conn.close()
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    latencies.sort()
+
+    def q(p: float) -> float:
+        if not latencies:
+            return float("nan")
+        return latencies[min(int(p * len(latencies)), len(latencies) - 1)] * 1e3
+
+    return {
+        "events": events,
+        "batchSize": batch_size,
+        "requests": n_requests,
+        "concurrency": concurrency,
+        "acked": acked[0],
+        "errors": len(errors),
+        "shed": shed[0],
+        "wallSec": round(wall, 3),
+        "eventsPerSec": round(acked[0] / wall, 1) if wall > 0 else 0.0,
+        "ackP50Ms": round(q(0.50), 3),
+        "ackP99Ms": round(q(0.99), 3),
+    }

@@ -31,7 +31,8 @@ OUT = os.path.join(REPO, "BENCH_TPU_MANUAL.json")
 # what a labeled cell measures. Only the primary cell runs the expensive
 # extras (serving latency, solver A/B, measured utilization).
 _PIN = {"BENCH_REBALANCE": "1", "BENCH_DTYPE": "f32"}
-_LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0"}
+_LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
+         "BENCH_INGEST": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -123,6 +124,18 @@ def main() -> int:
         "degraded": None, "query_errors": None, "clean": None,
     }
     artifact["resilience"] = resilience
+    # ingest trajectory: the primary cell's sqlite ingest bench — the
+    # batched-vs-per-event-commit ratio is THE acceptance number for the
+    # write path, so it gets the same top-level grep-ability
+    ingest = primary.get("ingest") or {}
+    artifact["ingest"] = {
+        "vs_baseline": ingest.get("vs_baseline"),
+        "batched_events_per_sec": ingest.get("batched_events_per_sec"),
+        "buffered_events_per_sec": ingest.get("buffered_events_per_sec"),
+        "ack_p99_ms": ingest.get("ack_p99_ms"),
+        "avg_flush_batch": ingest.get("avg_flush_batch"),
+        "flush_errors": ingest.get("flush_errors"),
+    }
     with open(final, "w") as f:
         json.dump(artifact, f, indent=1)
     print(json.dumps({
@@ -131,6 +144,7 @@ def main() -> int:
         "on_tpu": all_tpu,
         **serving,
         "resilience": resilience,
+        "ingest": artifact["ingest"],
     }))
     return 0 if all_tpu else 1
 
