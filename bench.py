@@ -432,6 +432,139 @@ def _http_latency(ctx, dist, n_users, n_items) -> dict:
         memory.reset_store(src)
 
 
+def _observability_bench(ctx) -> dict:
+    """Telemetry overhead gate: HTTP serving p50 with the obs subsystem ON
+    (trace sampling forced to 1.0 — every request traced, the worst case)
+    vs OFF (``telemetry=False``: no registry, no tracer, the pre-obs hot
+    loop), same trained model, same rotated payloads.
+
+    ``overhead_ratio`` is p50_on / p50_off; the gate is <3%.  Each config
+    takes the min-of-3 p50 so one GC pause or scheduler hiccup can't fail
+    the gate on noise.  The ON server is also asked for ``/metrics`` and
+    ``/trace/recent.json`` so the record carries proof the exposition was
+    live while the gate was measured.
+    """
+    import urllib.request as _rq
+    import uuid
+
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.data.batch import EventBatch
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.serving.query_server import QueryServer
+    from predictionio_tpu.templates.recommendation import RecommendationEngine
+    from predictionio_tpu.tools.loadtest import run_loadtest
+
+    n_events = int(os.environ.get("BENCH_OBS_EVENTS", 100_000))
+    n_users, n_items = 5000, 2000
+    requests = int(os.environ.get("BENCH_OBS_REQUESTS", 300))
+    src = "OBSBENCH" + uuid.uuid4().hex[:6].upper()
+    storage = Storage(env={
+        f"PIO_STORAGE_SOURCES_{src}_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+    })
+    store_mod.set_storage(storage)
+    prev_sample = os.environ.get("PIO_TRACE_SAMPLE")
+    try:
+        app_id = storage.get_meta_data_apps().insert(App(0, "obsbenchapp"))
+        storage.get_l_events().init(app_id)
+        rng = np.random.default_rng(23)
+        users = rng.integers(0, n_users, n_events)
+        items = rng.integers(0, n_items, n_events)
+        now = time.time()
+        batch = EventBatch(
+            event=np.full(n_events, "rate", object),
+            entity_type=np.full(n_events, "user", object),
+            entity_id=np.array([f"u{u}" for u in users], object),
+            target_entity_type=np.full(n_events, "item", object),
+            target_entity_id=np.array([f"i{i}" for i in items], object),
+            event_time=np.full(n_events, now, np.float64),
+            properties=[
+                {"rating": float(r)} for r in rng.integers(1, 6, n_events)
+            ],
+        )
+        storage.get_p_events().write(batch, app_id)
+        engine = RecommendationEngine.apply()
+        ep = engine.params_from_variant({
+            "datasource": {"params": {"appName": "obsbenchapp"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 10, "numIterations": 2}}
+            ],
+        })
+        run_train(engine, ep, "obsbench", storage=storage, ctx=ctx)
+        distinct = [f"u{u}" for u in dict.fromkeys(users.tolist())][:256]
+        sample = {"user": distinct}
+        os.environ["PIO_TRACE_SAMPLE"] = "1.0"  # every request traced
+
+        def measure(telemetry: bool) -> tuple:
+            qs = QueryServer(
+                engine, storage=storage, ctx=ctx, batching=True,
+                telemetry=telemetry,
+            )
+            port = qs.start("127.0.0.1", 0)
+            url = f"http://127.0.0.1:{port}"
+            try:
+                run_loadtest(url, {"num": 10}, requests=60, concurrency=2,
+                             samples=sample)  # warm the path + jit
+                p50s = []
+                for _ in range(3):
+                    r = run_loadtest(url, {"num": 10}, requests=requests,
+                                     concurrency=4, samples=sample)
+                    p50s.append(r["p50Ms"])
+                proof = None
+                if telemetry:
+                    with _rq.urlopen(url + "/metrics", timeout=10) as r:
+                        text = r.read().decode()
+                    from predictionio_tpu.obs.metrics import parse_prometheus
+
+                    series = parse_prometheus(text)
+                    with _rq.urlopen(
+                        url + "/trace/recent.json?limit=50", timeout=10
+                    ) as r:
+                        traces = json.loads(r.read().decode())["traces"]
+                    # newest trace is the /metrics scrape itself; the proof
+                    # wants a QUERY trace with the full stage breakdown
+                    qtraces = [
+                        t for t in traces
+                        if "/queries.json" in t.get("name", "")
+                    ]
+                    proof = {
+                        "metric_series": len(series),
+                        "trace_stages": sorted(
+                            qtraces[0]["stagesMs"]
+                        ) if qtraces else [],
+                    }
+                return min(p50s), proof
+            finally:
+                qs.stop()
+
+        p50_on, proof = measure(True)
+        p50_off, _ = measure(False)
+        ratio = p50_on / p50_off if p50_off > 0 else float("nan")
+        return {
+            "p50_on_ms": p50_on,
+            "p50_off_ms": p50_off,
+            "overhead_ratio": round(ratio, 4),
+            "gate": 1.03,
+            "gate_pass": bool(ratio <= 1.03),
+            "trace_sample": 1.0,
+            "requests_per_run": requests,
+            **(proof or {}),
+        }
+    finally:
+        if prev_sample is None:
+            os.environ.pop("PIO_TRACE_SAMPLE", None)
+        else:
+            os.environ["PIO_TRACE_SAMPLE"] = prev_sample
+        store_mod.set_storage(None)
+        from predictionio_tpu.data.storage import memory
+
+        memory.reset_store(src)
+
+
 def _ingest_bench() -> dict:
     """Ingest fast-path evidence on the sqlite backend (the fsync-bound
     one): per-event-commit baseline vs one-transaction ``insert_batch`` vs
@@ -743,6 +876,14 @@ def main() -> None:
             print(f"WARNING: ingest bench failed: {e}", file=sys.stderr)
             ingest = {"error": str(e)}
         print(f"INFO: ingest: {ingest}", file=sys.stderr)
+    observability = None
+    if os.environ.get("BENCH_OBS", "1") != "0":
+        try:
+            observability = _observability_bench(ctx)
+        except Exception as e:  # the obs gate must never kill the artifact
+            print(f"WARNING: observability bench failed: {e}", file=sys.stderr)
+            observability = {"error": str(e)}
+        print(f"INFO: observability: {observability}", file=sys.stderr)
     record = {
         "metric": "als_train_events_per_sec_per_chip",
         "value": round(value, 1),
@@ -775,6 +916,8 @@ def main() -> None:
             record["resilience"] = http_res
     if ingest is not None:
         record["ingest"] = ingest
+    if observability is not None:
+        record["observability"] = observability
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
 from predictionio_tpu.common import faults as _faults
+from predictionio_tpu.obs import tracing as _tracing
 
 
 @dataclass
@@ -29,6 +30,9 @@ class Request:
     headers: Any
     body: bytes
     match: Optional[re.Match] = None
+    # the sampled obs trace riding this request (None when unsampled or
+    # telemetry is not installed); handlers pass it to async stages
+    trace: Any = None
 
     def json(self) -> Any:
         if not self.body:
@@ -117,6 +121,9 @@ class HttpService:
         self._exact: dict[tuple[str, str], Callable[[Request], Response]] = {}
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # obs.Telemetry installed via Telemetry.install(service); the hot
+        # loop pays ONE attribute check when absent
+        self.telemetry = None
 
     def route(self, method: str, pattern: str):
         regex = re.compile("^" + pattern + "$")
@@ -202,21 +209,58 @@ class HttpService:
                     if act.kind == "truncate":
                         # flag for _send: cut a streamed body mid-frame
                         self._fault_truncate = True
+                tel = service.telemetry
+                trace = None
+                if tel is not None:
+                    t_req = time.perf_counter()
+                    trace = tel.tracer.begin(
+                        request_id=self.headers.get(_tracing.TRACE_HEADER),
+                        name=f"{method} {parsed.path}",
+                    )
                 req = Request(
                     method=method,
                     path=parsed.path,
                     params=params,
                     headers=self.headers,
                     body=body,
+                    trace=trace,
                 )
                 try:
-                    resp = service.dispatch(req)
+                    if trace is not None:
+                        # active-trace scope: downstream stage() calls and
+                        # the storage client's header propagation see it
+                        with _tracing.scope((trace,)):
+                            resp = service.dispatch(req)
+                    else:
+                        resp = service.dispatch(req)
                 except json.JSONDecodeError as e:
                     resp = json_response(400, {"message": f"invalid JSON: {e}"})
                 except Exception as e:  # pragma: no cover - defensive
                     resp = json_response(500, {"message": str(e)})
+                if trace is not None:
+                    resp.headers.setdefault(
+                        _tracing.TRACE_HEADER, trace.request_id
+                    )
                 try:
-                    self._send(resp)
+                    if tel is None:
+                        self._send(resp)
+                    else:
+                        t_send = time.perf_counter()
+                        try:
+                            self._send(resp)
+                        finally:
+                            if trace is not None:
+                                trace.add_stage(
+                                    "serialize",
+                                    time.perf_counter() - t_send,
+                                )
+                                trace.finish(status=resp.status)
+                                tel.tracer.record(trace)
+                            tel.observe_http(
+                                method, parsed.path, resp.status,
+                                time.perf_counter() - t_req,
+                                (method, parsed.path) in service._exact,
+                            )
                 except (BrokenPipeError, ConnectionResetError):
                     # client went away mid-response; nothing to salvage
                     self.close_connection = True

@@ -24,9 +24,11 @@ import logging
 import os
 from typing import Optional
 
+from predictionio_tpu import obs
 from predictionio_tpu.common.http import HttpService, Request, Response, json_response
 from predictionio_tpu.data.api.ingest_buffer import BufferFull, IngestBuffer
 from predictionio_tpu.data.api.stats import Stats
+from predictionio_tpu.obs import bridges as _bridges
 from predictionio_tpu.data.event import Event, parse_time_or_none
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.data.webhooks.connector import (
@@ -70,6 +72,7 @@ class EventServer:
         ingest_mode: Optional[str] = None,
         ingest_flush_ms: Optional[float] = None,
         ingest_buffer_max: Optional[int] = None,
+        telemetry: bool = True,
     ):
         self.storage = storage or Storage.instance()
         self.stats_enabled = stats
@@ -103,7 +106,37 @@ class EventServer:
                 durable_ack=(mode == "durable"),
             )
         self.service = HttpService("eventserver")
+        # unified observability (obs/): /metrics + /trace/recent.json, and
+        # bridges that put every ingestion stat behind the one registry
+        self.telemetry = (
+            obs.Telemetry("eventserver").install(self.service)
+            if telemetry and obs.telemetry_enabled()
+            else None
+        )
+        if self.telemetry is not None:
+            self._register_metrics()
         self._register_routes()
+
+    def _register_metrics(self) -> None:
+        reg = self.telemetry.registry
+        _bridges.bridge_event_stats(reg, self.stats)
+        reg.gauge_fn(
+            "pio_stats_enabled",
+            "1 when per-app ingestion stats collection is on.",
+            lambda: 1.0 if self.stats_enabled else 0.0,
+        )
+        reg.gauge_fn(
+            "pio_ingest_buffer_enabled",
+            "1 when the group-commit write-behind buffer is active.",
+            lambda: 0.0 if self.ingest_buffer is None else 1.0,
+        )
+        if self.ingest_buffer is not None:
+            _bridges.bridge_ingest_buffer(reg, self.ingest_buffer.stats)
+        # a network-backed storage carries the retry/breaker client; its
+        # resilience state belongs on this server's exposition
+        storage_rs = getattr(self.storage, "resilience_stats", None)
+        if callable(storage_rs):
+            _bridges.bridge_resilience(reg, storage_rs)
 
     # -- auth (parity: withAccessKey, EventServer.scala:92-130) ------------
     def _authenticate(self, req: Request) -> tuple[Optional[dict], Optional[Response]]:
@@ -425,13 +458,20 @@ class EventServer:
 
         @svc.route("GET", r"/stats\.json")
         def stats_route(req):
-            auth, err = self._authenticate(req)
-            if err:
-                return err
             if not self.stats_enabled:
                 return json_response(
                     404, {"message": "To see stats, launch the server with stats enabled."}
                 )
+            has_key = bool(
+                req.params.get("accessKey")
+                or req.headers.get("Authorization")
+            )
+            if not has_key:
+                # no app scope requested: the cross-app operator readout
+                return json_response(200, self.stats.get_all())
+            auth, err = self._authenticate(req)
+            if err:
+                return err
             return json_response(200, self.stats.get(auth["app_id"]))
 
         @svc.route("POST", r"/webhooks/(?P<name>[^/]+)\.json")

@@ -3,32 +3,79 @@
 Parity: ``data/.../api/Stats.scala:28-80`` + ``StatsActor.scala:30-76`` —
 per-app counts keyed by (event name, status code) since server start,
 exposed at ``/stats.json``.  A lock replaces the actor mailbox.
+
+Two hardening rules beyond the reference:
+
+* **Bounded cardinality** — event names come off the wire, so a hostile
+  stream of unique names would otherwise grow the per-app counter map
+  without limit.  Past ``PIO_STATS_MAX_KEYS`` distinct (event, status)
+  keys per app, new event names collapse into the ``__overflow__``
+  bucket (per status code), keeping totals truthful at fixed memory.
+* **All-apps readout** — :meth:`Stats.get_all` backs ``/stats.json``
+  without an ``appId`` and the ``pio_events_ingested_total`` bridge on
+  ``/metrics``.
 """
 
 from __future__ import annotations
 
 import datetime as _dt
+import os
 import threading
 from collections import Counter
 
+OVERFLOW_EVENT = "__overflow__"
+
+
+def _max_keys_default() -> int:
+    return int(os.environ.get("PIO_STATS_MAX_KEYS", "1000"))
+
 
 class Stats:
-    def __init__(self):
+    def __init__(self, max_keys: int | None = None):
         self.start_time = _dt.datetime.now(tz=_dt.timezone.utc)
+        self.max_keys = (
+            max_keys if max_keys is not None else _max_keys_default()
+        )
         self._lock = threading.Lock()
         self._counts: dict[int, Counter] = {}
 
     def update(self, app_id: int, event_name: str, status_code: int) -> None:
         with self._lock:
-            self._counts.setdefault(app_id, Counter())[(event_name, status_code)] += 1
+            counts = self._counts.setdefault(app_id, Counter())
+            key = (event_name, status_code)
+            if key not in counts and len(counts) >= self.max_keys:
+                key = (OVERFLOW_EVENT, status_code)
+            counts[key] += 1
+
+    def _status_count(self, counts: Counter) -> list[dict]:
+        return [
+            {"event": ev, "status": status, "count": n}
+            for (ev, status), n in sorted(counts.items())
+        ]
 
     def get(self, app_id: int) -> dict:
         with self._lock:
             counts = self._counts.get(app_id, Counter())
             return {
                 "startTime": self.start_time.isoformat(),
-                "statusCount": [
-                    {"event": ev, "status": status, "count": n}
-                    for (ev, status), n in sorted(counts.items())
-                ],
+                "statusCount": self._status_count(counts),
+            }
+
+    def get_all(self) -> dict:
+        """Cross-app readout (``/stats.json`` without an appId)."""
+        with self._lock:
+            return {
+                "startTime": self.start_time.isoformat(),
+                "apps": {
+                    str(app_id): self._status_count(counts)
+                    for app_id, counts in sorted(self._counts.items())
+                },
+            }
+
+    def snapshot_all(self) -> dict[int, Counter]:
+        """Raw per-app counters (the ``/metrics`` bridge's input)."""
+        with self._lock:
+            return {
+                app_id: Counter(counts)
+                for app_id, counts in self._counts.items()
             }

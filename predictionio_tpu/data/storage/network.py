@@ -50,9 +50,11 @@ from typing import Any, Optional
 
 import numpy as np
 
+from predictionio_tpu import obs
 from predictionio_tpu.common import faults as _faults
 from predictionio_tpu.common import resilience
 from predictionio_tpu.common.http import HttpService, Request, Response, json_response
+from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.data import bimap
 from predictionio_tpu.data.batch import EventBatch, Interactions
 from predictionio_tpu.data.event import Event, PropertyMap, parse_time_or_none
@@ -297,10 +299,19 @@ class StorageServer:
     directory; every other host configures driver type ``network``.
     """
 
-    def __init__(self, storage, secret: Optional[str] = None):
+    def __init__(self, storage, secret: Optional[str] = None,
+                 telemetry: bool = True):
         self.storage = storage
         self.secret = secret
         self.service = HttpService("storageserver")
+        # /metrics + /trace/recent.json on the data plane too: an incoming
+        # X-Request-Id (propagated by the client) samples here, so a slow
+        # query's storage half shows up in THIS server's ring
+        self.telemetry = (
+            obs.Telemetry("storageserver").install(self.service)
+            if telemetry and obs.telemetry_enabled()
+            else None
+        )
         self._register()
 
     # route helpers --------------------------------------------------------
@@ -823,6 +834,12 @@ class _Client:
         headers = {"Content-Type": content_type}
         if self.secret:
             headers[SECRET_HEADER] = self.secret
+        active = _tracing.active_traces()
+        if active:
+            # cross-service correlation: the serving request's id rides
+            # every storage call it causes, so the storage server's trace
+            # ring and logs line up with the query's
+            headers[_tracing.TRACE_HEADER] = active[0].request_id
         req = urllib.request.Request(
             self.url + path, data=body, method=method, headers=headers
         )

@@ -279,6 +279,35 @@ class Storage:
         """Named monotonic counters (parity: ESSequences.scala role)."""
         return self.get_data_object(METADATA, "Sequences")
 
+    # -- observability ------------------------------------------------------
+    def resilience_stats(self) -> Optional[dict]:
+        """Aggregate retry/breaker state over cached network-driver DAOs.
+
+        None when no network client is live — the obs bridge then emits
+        nothing, so purely-local storage adds zero series.
+        """
+        merged: Optional[dict] = None
+        for obj in list(self._dao_cache.values()):
+            client = getattr(obj, "_c", None)
+            rs = getattr(client, "resilience_stats", None)
+            if not callable(rs):
+                continue
+            s = rs()
+            if merged is None:
+                merged = {
+                    "retries": 0, "retry_budget_tokens": None, "breakers": {},
+                }
+            merged["retries"] += s.get("retries") or 0
+            tokens = s.get("retry_budget_tokens")
+            if tokens is not None:
+                prior = merged["retry_budget_tokens"]
+                # most-exhausted client is the operational signal
+                merged["retry_budget_tokens"] = (
+                    tokens if prior is None else min(prior, tokens)
+                )
+            merged["breakers"].update(s.get("breakers") or {})
+        return merged
+
     # -- smoke check (parity: Storage.verifyAllDataObjects:372-394) --------
     def verify_all_data_objects(self) -> bool:
         """Touch every repository + write/read/delete one test event."""

@@ -1,0 +1,390 @@
+"""Bridges: existing component stats → registry Families at scrape time.
+
+Every load-bearing runtime layer predates the registry and already keeps
+its own thread-safe counters (``MicroBatcher.stats()``, fastpath
+``serving_stats``, ``ErrorCounters``, the ingest buffer, the storage
+client's breakers, the event-server ``Stats``).  Rather than re-homing
+those counters — and adding a second lock acquisition to every hot-path
+event — each bridge snapshots the component's existing ``stats()`` dict
+when ``/metrics`` is scraped and reshapes it into
+:class:`~predictionio_tpu.obs.metrics.Family` samples.  ``/metrics`` is
+the single source of truth; the components keep their single lock.
+
+All bridges tolerate missing keys (``.get`` with defaults) so a component
+evolving its stats dict degrades a series to 0 instead of breaking the
+exposition.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from predictionio_tpu.obs.metrics import Family, MetricsRegistry
+
+BREAKER_STATE_VALUES = {"closed": 0.0, "open": 1.0, "half_open": 2.0}
+
+
+def _fam(name: str, kind: str, help: str, samples: list) -> Family:
+    return Family(name, kind, help, samples)
+
+
+def _num(v, default=0.0) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return float(default)
+
+
+# -- serving: micro-batcher --------------------------------------------------
+
+def bridge_batcher(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """MicroBatcher occupancy/EWMA/drop stats → pio_batcher_* series."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        fams = [
+            _fam(
+                "pio_batcher_batches_total", "counter",
+                "Batches executed, split by formation kind.",
+                [
+                    ("", (("kind", "window"),),
+                     _num(s.get("batches")) - _num(s.get("inline_batches"))),
+                    ("", (("kind", "inline"),),
+                     _num(s.get("inline_batches"))),
+                ],
+            ),
+            _fam(
+                "pio_batcher_queries_total", "counter",
+                "Queries that passed through the micro-batcher.",
+                [("", (), _num(s.get("queries")))],
+            ),
+            _fam(
+                "pio_batcher_expired_dropped_total", "counter",
+                "Pendings dropped at dispatch because their deadline "
+                "expired while queued.",
+                [("", (), _num(s.get("expired_dropped")))],
+            ),
+            _fam(
+                "pio_batcher_depth", "gauge",
+                "Queries currently waiting in the batch queue.",
+                [("", (), _num(s.get("depth")))],
+            ),
+            _fam(
+                "pio_batcher_avg_batch", "gauge",
+                "Mean formed batch size (occupancy) since start.",
+                [("", (), _num(s.get("avg_batch")))],
+            ),
+            _fam(
+                "pio_batcher_window_wait_ms", "gauge",
+                "Mean window wait per batched query, milliseconds.",
+                [("", (), _num(s.get("avg_window_wait_ms")))],
+            ),
+            _fam(
+                "pio_batcher_ewma_gap_ms", "gauge",
+                "EWMA of inter-arrival gap driving the adaptive window.",
+                [("", (), _num(s.get("ewma_gap_ms")))],
+            ),
+            _fam(
+                "pio_batcher_ewma_run_ms", "gauge",
+                "EWMA of batch execution time driving the adaptive window.",
+                [("", (), _num(s.get("ewma_run_ms")))],
+            ),
+        ]
+        sizes = s.get("batch_sizes")
+        if isinstance(sizes, dict) and sizes:
+            fams.append(
+                _fam(
+                    "pio_batcher_batch_size_total", "counter",
+                    "Formed batches by size bucket.",
+                    [
+                        ("", (("size", str(k)),), _num(v))
+                        for k, v in sorted(
+                            sizes.items(), key=lambda kv: str(kv[0])
+                        )
+                    ],
+                )
+            )
+        return fams
+
+    registry.register_collector(collect)
+
+
+# -- serving: AOT fastpath ---------------------------------------------------
+
+def bridge_fastpath(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """BucketedScorer stats → pio_fastpath_* (compiles, bucket hits)."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        fams = [
+            _fam(
+                "pio_fastpath_compiles_total", "counter",
+                "XLA compilations performed by the bucketed scorer; flat "
+                "under traffic == the AOT warmup contract holds.",
+                [("", (), _num(s.get("compile_count")))],
+            ),
+            _fam(
+                "pio_fastpath_calls_total", "counter",
+                "score_topk invocations (one per formed batch).",
+                [("", (), _num(s.get("calls")))],
+            ),
+            _fam(
+                "pio_fastpath_queries_total", "counter",
+                "User rows scored through the fastpath.",
+                [("", (), _num(s.get("queries")))],
+            ),
+            _fam(
+                "pio_fastpath_padded_rows_total", "counter",
+                "Padding rows wasted by bucket rounding.",
+                [("", (), _num(s.get("padded_rows")))],
+            ),
+            _fam(
+                "pio_fastpath_row_occupancy", "gauge",
+                "Real rows / padded rows since start (1.0 = no waste).",
+                [("", (), _num(s.get("row_occupancy")))],
+            ),
+        ]
+        hits = s.get("bucket_hits")
+        if isinstance(hits, dict) and hits:
+            fams.append(
+                _fam(
+                    "pio_fastpath_bucket_hits_total", "counter",
+                    "Batches served per compiled bucket rung.",
+                    [
+                        ("", (("bucket", str(k)),), _num(v))
+                        for k, v in sorted(
+                            hits.items(), key=lambda kv: _num(kv[0])
+                        )
+                    ],
+                )
+            )
+        return fams
+
+    registry.register_collector(collect)
+
+
+# -- resilience: error counters + breakers -----------------------------------
+
+def bridge_error_counters(
+    registry: MetricsRegistry,
+    name: str,
+    help: str,
+    counters,
+) -> None:
+    """An :class:`~predictionio_tpu.common.resilience.ErrorCounters` →
+    one counter family labeled by kind (includes shed / deadline 504)."""
+
+    def collect():
+        snap = counters.snapshot()
+        return [
+            _fam(
+                name, "counter", help,
+                [
+                    ("", (("kind", str(k)),), _num(v))
+                    for k, v in sorted(snap.items())
+                ],
+            )
+        ]
+
+    registry.register_collector(collect)
+
+
+def bridge_resilience(
+    registry: MetricsRegistry,
+    stats_fn: Callable[[], Optional[dict]],
+    prefix: str = "pio_storage_client",
+) -> None:
+    """A storage client's ``resilience_stats()`` → retry counter, retry-
+    budget gauge, and per-endpoint breaker-state gauges (closed=0,
+    open=1, half_open=2)."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        fams = []
+        if "retries" in s:
+            fams.append(
+                _fam(
+                    f"{prefix}_retries_total", "counter",
+                    "Calls retried under the resilience policy.",
+                    [("", (), _num(s.get("retries")))],
+                )
+            )
+        if s.get("retry_budget_tokens") is not None:
+            fams.append(
+                _fam(
+                    f"{prefix}_retry_budget_tokens", "gauge",
+                    "Tokens left in the retry budget (exhausted == 0).",
+                    [("", (), _num(s.get("retry_budget_tokens")))],
+                )
+            )
+        breakers = s.get("breakers") or []
+        if isinstance(breakers, dict):
+            breakers = list(breakers.values())
+        state_samples, fail_samples, open_samples = [], [], []
+        for b in breakers:
+            ep = (("endpoint", str(b.get("endpoint", "?"))),)
+            state_samples.append(
+                ("", ep, BREAKER_STATE_VALUES.get(b.get("state"), -1.0))
+            )
+            fail_samples.append(
+                ("", ep, _num(b.get("consecutive_failures")))
+            )
+            open_samples.append(("", ep, _num(b.get("open_count"))))
+        if state_samples:
+            fams.extend(
+                [
+                    _fam(
+                        f"{prefix}_breaker_state", "gauge",
+                        "Circuit state per endpoint: 0 closed, 1 open, "
+                        "2 half-open.",
+                        state_samples,
+                    ),
+                    _fam(
+                        f"{prefix}_breaker_consecutive_failures", "gauge",
+                        "Consecutive failures seen by each breaker.",
+                        fail_samples,
+                    ),
+                    _fam(
+                        f"{prefix}_breaker_opens_total", "counter",
+                        "Times each breaker tripped open.",
+                        open_samples,
+                    ),
+                ]
+            )
+        return fams
+
+    registry.register_collector(collect)
+
+
+# -- data plane: event-server Stats + ingest buffer --------------------------
+
+def bridge_event_stats(registry: MetricsRegistry, stats) -> None:
+    """Event-server :class:`~predictionio_tpu.data.api.stats.Stats` →
+    pio_events_ingested_total{app_id,event,status} (cardinality is capped
+    at the Stats layer, overflow bucket included)."""
+
+    def collect():
+        samples = []
+        for app_id, counts in sorted(stats.snapshot_all().items()):
+            for (event, status), n in sorted(counts.items()):
+                samples.append(
+                    (
+                        "",
+                        (
+                            ("app_id", str(app_id)),
+                            ("event", str(event)),
+                            ("status", str(status)),
+                        ),
+                        _num(n),
+                    )
+                )
+        return [
+            _fam(
+                "pio_events_ingested_total", "counter",
+                "Events processed per app, event name, and HTTP status.",
+                samples,
+            )
+        ]
+
+    registry.register_collector(collect)
+
+
+def bridge_ingest_buffer(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """Write-behind ingest buffer → depth gauge, flow counters, and the
+    flush batch-size histogram."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        fams = [
+            _fam(
+                "pio_ingest_buffer_depth", "gauge",
+                "Events currently buffered awaiting flush.",
+                [("", (), _num(s.get("buffered")))],
+            ),
+            _fam(
+                "pio_ingest_buffer_capacity", "gauge",
+                "Configured buffer bound (overflow == shed).",
+                [("", (), _num(s.get("buffer_max")))],
+            ),
+            _fam(
+                "pio_ingest_events_total", "counter",
+                "Buffered-ingest events by outcome.",
+                [
+                    ("", (("outcome", "accepted"),),
+                     _num(s.get("accepted"))),
+                    ("", (("outcome", "flushed"),), _num(s.get("flushed"))),
+                    ("", (("outcome", "overflow"),),
+                     _num(s.get("overflows"))),
+                ],
+            ),
+            _fam(
+                "pio_ingest_flushes_total", "counter",
+                "Group-commit flushes executed.",
+                [("", (), _num(s.get("flushes")))],
+            ),
+            _fam(
+                "pio_ingest_flush_retries_total", "counter",
+                "Flush attempts retried under the resilience policy.",
+                [("", (), _num(s.get("retries")))],
+            ),
+            _fam(
+                "pio_ingest_flush_errors_total", "counter",
+                "Flushes that exhausted retries and failed their tickets.",
+                [("", (), _num(s.get("flush_errors")))],
+            ),
+        ]
+        hist = s.get("flush_batch_hist")
+        if isinstance(hist, dict) and hist:
+            fams.append(
+                _fam(
+                    "pio_ingest_flush_batch_total", "counter",
+                    "Flushes by batch-size bucket.",
+                    [
+                        ("", (("size", str(k)),), _num(v))
+                        for k, v in hist.items()
+                    ],
+                )
+            )
+        return fams
+
+    registry.register_collector(collect)
+
+
+# -- latency histogram (existing log₂ profiler histogram) --------------------
+
+def bridge_latency_histogram(
+    registry: MetricsRegistry, name: str, help: str, hist
+) -> None:
+    """A :class:`utils.profiling.LatencyHistogram` → Prometheus histogram
+    samples (cumulative ``le`` in seconds), without double-observing in
+    the hot path."""
+
+    def collect():
+        with hist._lock:
+            counts = [int(c) for c in hist._counts]
+            total = int(hist.total)
+        samples = []
+        acc = 0
+        for b, c in enumerate(counts):
+            acc += c
+            upper_s = hist._bucket_upper_ms(b) / 1e3
+            samples.append(("_bucket", (("le", f"{upper_s:.6g}"),), acc))
+        samples.append(("_bucket", (("le", "+Inf"),), total))
+        samples.append(("_count", (), total))
+        return [_fam(name, "histogram", help, samples)]
+
+    registry.register_collector(collect)

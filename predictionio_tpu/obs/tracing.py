@@ -1,0 +1,217 @@
+"""Request-scoped tracing: per-stage breakdown, sampling, bounded ring.
+
+A :class:`Trace` is born at HTTP accept (``common/http.py``), rides the
+request through the serving pipeline, and lands in a bounded in-memory
+ring exposed at ``GET /trace/recent.json``.  Stages recorded on the query
+path:
+
+``decode`` → ``queue_wait`` (MicroBatcher) → ``batch_assembly`` → ``h2d``
+→ ``device_compute`` (via the :func:`utils.profiling.trace` hook) →
+``serialize``; whatever wall time the named stages don't cover lands in
+an explicit ``other`` remainder so the stage sum always reconciles with
+wall time.
+
+Propagation contract (documented in docs/observability.md):
+
+* The ``X-Request-Id`` header carries the trace id.  A request that
+  ARRIVES with one is always sampled (upstream already decided), and the
+  id is propagated by the NetworkStorage client on every outgoing call so
+  a query's storage round-trips correlate across services.  The response
+  echoes the id back.
+* Requests without the header are head-sampled at ``PIO_TRACE_SAMPLE``
+  (deterministic every-Nth admission — no RNG in the hot path).
+
+Cross-thread attribution: the micro-batcher executes ONE batch for many
+requests, so the worker thread installs every batch member's trace as
+"active" (:func:`scope`) and shared stages (``h2d``, ``device_compute``)
+are charged to each of them — the per-request view stays truthful about
+where its wall time went even when the work was amortized.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Optional, Sequence
+
+TRACE_HEADER = "X-Request-Id"
+
+DEFAULT_SAMPLE_RATE = 0.1
+DEFAULT_RING_SIZE = 256
+
+
+class Trace:
+    """One sampled request: stage durations + identity. Thread-safe."""
+
+    __slots__ = (
+        "request_id", "name", "start_unix", "_t0", "stages", "meta",
+        "wall_s", "status", "_lock",
+    )
+
+    def __init__(self, request_id: str, name: str = ""):
+        self.request_id = request_id
+        self.name = name
+        self.start_unix = time.time()
+        self._t0 = time.perf_counter()
+        self.stages: dict[str, float] = {}
+        self.meta: dict = {}
+        self.wall_s: Optional[float] = None
+        self.status: Optional[int] = None
+        self._lock = threading.Lock()
+
+    def add_stage(self, stage: str, seconds: float) -> None:
+        """Accumulate time into a named stage (re-entry adds, not replaces)."""
+        if seconds < 0:
+            seconds = 0.0
+        with self._lock:
+            self.stages[stage] = self.stages.get(stage, 0.0) + seconds
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_stage(name, time.perf_counter() - t0)
+
+    def finish(self, status: Optional[int] = None) -> None:
+        wall = time.perf_counter() - self._t0
+        with self._lock:
+            self.wall_s = wall
+            self.status = status
+            # the explicit remainder: stage sum ≡ wall by construction, so
+            # a reader never wonders whether missing time means missing
+            # instrumentation or missing truth
+            covered = sum(self.stages.values())
+            self.stages["other"] = max(0.0, wall - covered)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "requestId": self.request_id,
+                "name": self.name,
+                "startUnix": round(self.start_unix, 6),
+                "wallMs": (
+                    None if self.wall_s is None
+                    else round(self.wall_s * 1e3, 4)
+                ),
+                "status": self.status,
+                "stagesMs": {
+                    k: round(v * 1e3, 4) for k, v in self.stages.items()
+                },
+                **({"meta": dict(self.meta)} if self.meta else {}),
+            }
+
+
+# -- active-trace propagation (thread-local) ---------------------------------
+
+_active = threading.local()
+
+
+def active_traces() -> Sequence[Trace]:
+    return getattr(_active, "traces", ())
+
+
+@contextlib.contextmanager
+def scope(traces: Sequence[Optional[Trace]]):
+    """Install traces as this thread's active set for the duration.
+
+    The HTTP thread scopes its single request trace around dispatch; the
+    micro-batcher worker scopes the whole batch's traces around execute.
+    """
+    prev = getattr(_active, "traces", ())
+    _active.traces = tuple(t for t in traces if t is not None)
+    try:
+        yield
+    finally:
+        _active.traces = prev
+
+
+@contextlib.contextmanager
+def stage(name: str):
+    """Charge the enclosed wall time to ``name`` on every active trace.
+
+    The no-trace case is two attribute lookups — cheap enough to leave in
+    hot loops permanently.
+    """
+    traces = getattr(_active, "traces", ())
+    if not traces:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        for t in traces:
+            t.add_stage(name, dt)
+
+
+def add_stage(name: str, seconds: float) -> None:
+    """Charge an externally-measured duration to every active trace."""
+    for t in getattr(_active, "traces", ()):
+        t.add_stage(name, seconds)
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Tracer:
+    """Head sampler + bounded ring of finished traces."""
+
+    def __init__(
+        self,
+        sample_rate: Optional[float] = None,
+        ring_size: Optional[int] = None,
+    ):
+        if sample_rate is None:
+            sample_rate = float(
+                os.environ.get("PIO_TRACE_SAMPLE", DEFAULT_SAMPLE_RATE)
+            )
+        if ring_size is None:
+            ring_size = int(
+                os.environ.get("PIO_TRACE_RING", DEFAULT_RING_SIZE)
+            )
+        self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
+        self.ring_max = max(1, int(ring_size))
+        self.ring: deque = deque(maxlen=self.ring_max)
+        self.seen = 0
+        self.sampled = 0
+        self._acc = 0.0
+        self._lock = threading.Lock()
+
+    def begin(
+        self,
+        request_id: Optional[str] = None,
+        name: str = "",
+    ) -> Optional[Trace]:
+        """Head-sampling decision; returns a live Trace or None.
+
+        An explicit ``request_id`` (the header arrived) always samples —
+        upstream made the decision and cross-service stitching needs the
+        downstream half.  Otherwise a deterministic every-Nth accumulator
+        admits ``sample_rate`` of traffic with zero RNG cost.
+        """
+        with self._lock:
+            self.seen += 1
+            if request_id is None:
+                self._acc += self.sample_rate
+                if self._acc < 1.0:
+                    return None
+                self._acc -= 1.0
+            self.sampled += 1
+        return Trace(request_id or new_request_id(), name=name)
+
+    def record(self, trace: Trace) -> None:
+        self.ring.append(trace)  # deque append is atomic
+
+    def recent(self, limit: Optional[int] = None) -> list:
+        traces = list(self.ring)
+        if limit:
+            traces = traces[-limit:]
+        return [t.to_dict() for t in reversed(traces)]

@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from predictionio_tpu.common.resilience import Deadline, DeadlineExceeded
+from predictionio_tpu.obs import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -55,6 +56,10 @@ class _Pending:
     event: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: Optional[BaseException] = None
+    # obs trace riding this query (captured from the submitting thread's
+    # active scope) + enqueue stamp for the queue_wait stage
+    trace: Any = None
+    t_enq: float = 0.0
 
 
 class MicroBatcher:
@@ -125,7 +130,11 @@ class MicroBatcher:
                 self._ewma_gap += self.ALPHA * (gap - self._ewma_gap)
             self._last_arrival = now
         eff = Deadline.min(deadline, Deadline.after_ms(timeout * 1e3))
-        p = _Pending(query, deadline=eff)
+        active = _tracing.active_traces()
+        p = _Pending(
+            query, deadline=eff,
+            trace=active[0] if active else None, t_enq=now,
+        )
         if eff.expired():
             # already over budget at arrival: shed before any queue/device
             # work (the admission layer normally catches this first)
@@ -280,8 +289,18 @@ class MicroBatcher:
         if not batch:
             return
         t_run = time.perf_counter()
+        traces = [p.trace for p in batch if p.trace is not None]
+        for p in batch:
+            if p.trace is not None:
+                # time between enqueue and dispatch: the coalescing window
+                # the request paid for (≈0 on the inline bypass)
+                p.trace.add_stage("queue_wait", t_run - p.t_enq)
         try:
-            results = self._run_batch([p.query for p in batch])
+            # the worker thread runs ONE batch for many requests: install
+            # every member's trace so shared stages (assembly, h2d, device
+            # compute) are charged to each of them
+            with _tracing.scope(traces):
+                results = self._run_batch([p.query for p in batch])
             if len(results) != len(batch):
                 raise RuntimeError(
                     f"batch_predict returned {len(results)} results for "

@@ -31,8 +31,10 @@ from typing import Optional
 import jax
 import numpy as np
 
+from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.ops.topk import gather_score_topk
 from predictionio_tpu.parallel.mesh import MeshContext, pad_to_multiple
+from predictionio_tpu.utils import profiling as _profiling
 
 # The batch-size ladder. Powers of two above a singleton lane: 1 serves the
 # trickle case with zero padding, 64 matches MicroBatcher's default
@@ -119,8 +121,16 @@ class BucketedScorer:
             b = bucket_for(len(chunk), self.buckets)
             padded = np.zeros(b, np.int32)
             padded[: len(chunk)] = chunk
-            u_dev = jax.device_put(padded, self._repl)
-            vals, idx = self._fns[b](self._U, self._V, self._item_pad_mask, u_dev)
+            with _tracing.stage("h2d"):
+                u_dev = jax.device_put(padded, self._repl)
+            with _profiling.trace(stage="device_compute"):
+                vals, idx = self._fns[b](
+                    self._U, self._V, self._item_pad_mask, u_dev
+                )
+                if _tracing.active_traces():
+                    # force completion INSIDE the stage so async dispatch
+                    # can't smear device time into the d2h readback below
+                    jax.block_until_ready((vals, idx))
             with self._lock:
                 self.hits[b] += 1
                 self.queries += len(chunk)

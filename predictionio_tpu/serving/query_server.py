@@ -42,12 +42,15 @@ from predictionio_tpu.common.resilience import (
     call_with_resilience,
     parse_deadline_header,
 )
+from predictionio_tpu import obs
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.workflow import (
     get_latest_completed_instance,
     prepare_deploy,
 )
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs import bridges as _bridges
+from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.utils.profiling import LatencyHistogram
 
@@ -132,6 +135,8 @@ class QueryServer:
         max_inflight: int = 256,
         shed_retry_after_s: float = 1.0,
         default_deadline_ms: Optional[float] = None,
+        warm_fastpath: Optional[bool] = None,
+        telemetry: bool = True,
     ):
         self.engine = engine
         self.storage = storage or Storage.instance()
@@ -152,6 +157,13 @@ class QueryServer:
         self.last_serving_sec = 0.0
         self.latency = LatencyHistogram()
         self.service = HttpService("queryserver")
+        # unified observability (obs/): /metrics + /trace/recent.json, and
+        # the HTTP layer's request counter / latency / trace hooks
+        self.telemetry = (
+            obs.Telemetry("queryserver").install(self.service)
+            if telemetry and obs.telemetry_enabled()
+            else None
+        )
         # feedback POSTs ride a bounded background queue, never the request
         # thread; when the event server can't keep up we drop (and count)
         # rather than let feedback add to serve latency
@@ -183,9 +195,15 @@ class QueryServer:
         # with {"degraded": true} instead of a 500
         self._last_good: Optional[dict] = None
         self._reload_degraded = False
-        # AOT fastpath warmup only pays off where batches actually form; a
-        # plain per-request server (most tests) skips the per-bucket compiles
-        self._warm_fastpath = batching
+        # AOT fastpath warmup: every bucket rung compiles at deploy/reload,
+        # BEFORE the generation swap, so no live request ever pays
+        # trace/compile latency.  Default follows `batching` (the fastpath
+        # only serves formed batches; a plain per-request server — most
+        # tests — skips the per-bucket compiles); pass warm_fastpath
+        # explicitly to override either way.
+        self._warm_fastpath = (
+            batching if warm_fastpath is None else bool(warm_fastpath)
+        )
         self._register_routes()
         self.reload()
         self._batcher = None
@@ -197,6 +215,8 @@ class QueryServer:
                 self._run_query_batch, max_batch=max_batch,
                 window_ms=batch_window_ms, buckets=fastpath.BUCKETS,
             )
+        if self.telemetry is not None:
+            self._register_metrics()
 
     # -- model lifecycle -----------------------------------------------------
     def reload(self) -> str:
@@ -257,13 +277,95 @@ class QueryServer:
         logger.info("deployed engine instance %s", instance.id)
         return instance.id
 
+    # -- observability -------------------------------------------------------
+    def _fastpath_stats(self) -> Optional[dict]:
+        """First deployed algorithm's serving_stats (registry bridge)."""
+        with self._lock:
+            d = self._deployed
+        if d is None:
+            return None
+        for algo, model in zip(d.algorithms, d.models):
+            get_stats = getattr(algo, "serving_stats", None)
+            if get_stats is None:
+                continue
+            s = get_stats(model)
+            if s is not None:
+                return s
+        return None
+
+    def _register_metrics(self) -> None:
+        """Expose every scattered serving stat on the obs registry, making
+        ``/metrics`` the single source of truth for this server."""
+        reg = self.telemetry.registry
+        _bridges.bridge_error_counters(
+            reg, "pio_query_errors_total",
+            "Serving failures by kind (shed, deadline 504, breaker_open, "
+            "degraded, query/warmup/sniffer/feedback/reload).",
+            self.counters,
+        )
+        _bridges.bridge_latency_histogram(
+            reg, "pio_query_latency_seconds",
+            "handle_query latency, bridged from the serving histogram.",
+            self.latency,
+        )
+        reg.gauge_fn(
+            "pio_query_inflight",
+            "Queries currently inside the admission gate.",
+            lambda: float(self._inflight),
+        )
+        reg.gauge_fn(
+            "pio_query_max_inflight",
+            "Admission-control bound; at or beyond it requests shed (503).",
+            lambda: float(self.max_inflight),
+        )
+        if self._batcher is not None:
+            _bridges.bridge_batcher(reg, self._batcher.stats)
+        _bridges.bridge_fastpath(reg, self._fastpath_stats)
+        _bridges.bridge_resilience(
+            reg,
+            lambda: {"breakers": [self._feedback_breaker.stats()]},
+            prefix="pio_feedback",
+        )
+        storage_rs = getattr(self.storage, "resilience_stats", None)
+        if callable(storage_rs):
+            _bridges.bridge_resilience(reg, storage_rs)
+
+        def _serving_families():
+            with self._lock:
+                rc = self.request_count
+                avg = self.avg_serving_sec
+                last = self.last_serving_sec
+                dropped = self._feedback_dropped
+            F = _bridges.Family
+            return [
+                F("pio_query_requests_total", "counter",
+                  "Queries served by the predict hot loop.",
+                  [("", (), float(rc))]),
+                F("pio_query_avg_serving_seconds", "gauge",
+                  "Running mean serving seconds (parity: CreateServer "
+                  "avg gauge).", [("", (), float(avg))]),
+                F("pio_query_last_serving_seconds", "gauge",
+                  "Most recent serving seconds.", [("", (), float(last))]),
+                F("pio_feedback_dropped_total", "counter",
+                  "Feedback events dropped on a full queue.",
+                  [("", (), float(dropped))]),
+                F("pio_reload_degraded", "gauge",
+                  "1 while serving the last good generation after a "
+                  "failed reload.",
+                  [("", (), 1.0 if self._reload_degraded else 0.0)]),
+            ]
+
+        reg.register_collector(_serving_families)
+
     # -- batched path: one Algorithm.batch_predict pass for N queries --------
     def _run_query_batch(self, queries: list) -> list:
         with self._lock:
             deployed = self._deployed
-        supplemented = [
-            (i, deployed.serving.supplement(q)) for i, q in enumerate(queries)
-        ]
+        with _tracing.stage("batch_assembly"):
+            supplemented = [
+                (i, deployed.serving.supplement(q))
+                for i, q in enumerate(queries)
+            ]
         per_algo = [
             dict(algo.batch_predict(model, supplemented))
             for algo, model in zip(deployed.algorithms, deployed.models)
@@ -310,7 +412,8 @@ class QueryServer:
         t0 = time.perf_counter()
         with self._lock:
             deployed = self._deployed
-        query = bind_query(self.engine.query_cls, data)
+        with _tracing.stage("decode"):
+            query = bind_query(self.engine.query_cls, data)
         degraded = False
         try:
             if deadline is not None and deadline.expired():
@@ -326,7 +429,8 @@ class QueryServer:
                     for algo, model in zip(deployed.algorithms, deployed.models)
                 ]
                 prediction = deployed.serving.serve(supplemented, predictions)
-            result = _to_jsonable(prediction)
+            with _tracing.stage("serialize"):
+                result = _to_jsonable(prediction)
         except DeadlineExceeded:
             self.counters.inc("deadline_exceeded")
             raise
@@ -528,7 +632,8 @@ class QueryServer:
 
         @svc.route("POST", r"/queries\.json")
         def queries(req: Request):
-            data = req.json()
+            with _tracing.stage("decode"):
+                data = req.json()
             if not isinstance(data, dict):
                 return json_response(400, {"message": "query must be a JSON object"})
             # admission control: beyond max_inflight, queueing only adds

@@ -589,20 +589,34 @@ def cmd_instances(args) -> int:
 def cmd_loadtest(args) -> int:
     from predictionio_tpu.tools.loadtest import run_ingest_loadtest, run_loadtest
 
+    url = f"http://{args.ip}:{args.port}"
+
+    def attach_metrics(result: dict) -> dict:
+        if not args.scrape_metrics:
+            return result
+        from predictionio_tpu.tools.loadtest import (
+            scrape_metrics, summarize_metrics,
+        )
+        try:
+            result["serverMetrics"] = summarize_metrics(scrape_metrics(url))
+        except Exception as e:  # report, don't fail the loadtest itself
+            result["serverMetrics"] = {"error": str(e)}
+        return result
+
     if args.events:
         # ingest mode: hammer a live Event Server instead of a query server
         if not args.access_key:
             print("[ERROR] --events mode needs --access-key")
             return 1
         result = run_ingest_loadtest(
-            url=f"http://{args.ip}:{args.port}",
+            url=url,
             access_key=args.access_key,
             events=args.events,
             concurrency=args.concurrency,
             batch_size=args.batch_size,
             channel=args.channel,
         )
-        print(json.dumps(result))
+        print(json.dumps(attach_metrics(result)))
         return 0 if result["errors"] == 0 else 1
     samples = {}
     for spec in args.sample or []:
@@ -614,14 +628,14 @@ def cmd_loadtest(args) -> int:
             return 1
         samples[field] = values
     result = run_loadtest(
-        url=f"http://{args.ip}:{args.port}",
+        url=url,
         query=json.loads(args.query),
         requests=args.requests,
         concurrency=args.concurrency,
         samples=samples or None,
         deadline_ms=args.deadline_ms,
     )
-    print(json.dumps(result))
+    print(json.dumps(attach_metrics(result)))
     return 0 if result["errors"] == 0 else 1
 
 
@@ -857,6 +871,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--channel", default=None,
                     help="--events mode: target channel name")
+    sp.add_argument(
+        "--scrape-metrics", action="store_true",
+        help="after the run, GET /metrics off the server under test and "
+        "include a server-side summary (batch occupancy, fastpath "
+        "compiles, breaker states) in the JSON report",
+    )
     sp.set_defaults(func=cmd_loadtest)
 
     sub.add_parser("upgrade").set_defaults(func=cmd_upgrade)

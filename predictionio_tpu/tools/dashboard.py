@@ -10,14 +10,21 @@ from __future__ import annotations
 import html
 from typing import Optional
 
+from predictionio_tpu import obs
 from predictionio_tpu.common.http import HttpService, Response, json_response
 from predictionio_tpu.data.storage.registry import Storage
 
 
 class Dashboard:
-    def __init__(self, storage: Optional[Storage] = None):
+    def __init__(self, storage: Optional[Storage] = None,
+                 telemetry: bool = True):
         self.storage = storage or Storage.instance()
         self.service = HttpService("dashboard")
+        self.telemetry = (
+            obs.Telemetry("dashboard").install(self.service)
+            if telemetry and obs.telemetry_enabled()
+            else None
+        )
         self._register()
 
     CORS_HEADERS = {  # parity: tools/dashboard/CorsSupport.scala

@@ -22,6 +22,61 @@ import time
 import urllib.parse
 
 
+def scrape_metrics(url: str, timeout: float = 10.0) -> dict:
+    """Scrape ``GET /metrics`` off the server under test and return the
+    parsed series as ``{(name, ((label, value), ...)): value}``.
+
+    The load test's client-side quantiles say what callers experienced;
+    the scrape says what the server *did* (batch occupancy, fastpath
+    compile count, shed counters).  Run it after the load so the deltas
+    reflect the run.  Raises on transport errors or an invalid
+    exposition — a loadtest that can't trust its telemetry should say so
+    rather than report half a picture.
+    """
+    from predictionio_tpu.obs.metrics import parse_prometheus
+
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.hostname
+    port = parsed.port or (443 if parsed.scheme == "https" else 80)
+    conn_cls = (
+        http.client.HTTPSConnection
+        if parsed.scheme == "https"
+        else http.client.HTTPConnection
+    )
+    conn = conn_cls(host, port, timeout=timeout)
+    try:
+        conn.request("GET", (parsed.path.rstrip("/") or "") + "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode("utf-8", "replace")
+        if resp.status != 200:
+            raise RuntimeError(f"GET /metrics -> HTTP {resp.status}")
+        return parse_prometheus(body)
+    finally:
+        conn.close()
+
+
+def summarize_metrics(series: dict) -> dict:
+    """Condense a :func:`scrape_metrics` result to the handful of series a
+    loadtest report cares about (JSON-friendly, stable keys)."""
+
+    def total(name: str) -> float:
+        return sum(v for (n, _), v in series.items() if n == name)
+
+    out = {
+        "seriesCount": len(series),
+        "httpRequests": total("pio_http_requests_total"),
+        "fastpathCompiles": total("pio_fastpath_compiles_total"),
+        "batcherQueries": total("pio_batcher_queries_total"),
+        "eventsIngested": total("pio_events_ingested_total"),
+    }
+    for (name, labels), v in sorted(series.items()):
+        if name.endswith("_breaker_state"):
+            out.setdefault("breakerStates", {})[
+                ",".join(f"{k}={val}" for k, val in labels)
+            ] = v
+    return out
+
+
 def run_loadtest(
     url: str,
     query: dict,

@@ -69,3 +69,72 @@ def test_no_unused_imports():
 def test_all_modules_parse():
     for path in iter_modules():
         ast.parse(open(path).read(), filename=path)
+
+
+# -- telemetry hygiene: no ad-hoc module-level counters -----------------------
+
+# Legacy module-level counters that predate the obs registry, grandfathered
+# as "path:target". EMPTY as of the obs PR — every global counter found by
+# this lint after that point is a regression: new aggregates belong on the
+# server's MetricsRegistry (or behind a bridge in obs/bridges.py), not in
+# module globals that /metrics can't see.
+COUNTER_ALLOWLIST: set[str] = set()
+
+_COUNTERISH_CALLS = {"Counter", "ErrorCounters", "defaultdict"}
+_COUNTERISH_NAMES = ("_count", "_counts", "_counter", "_counters", "_stats")
+
+
+def module_level_counters(path: str) -> list[str]:
+    """Module-level assignments that smell like an ad-hoc metrics store:
+    ``X = Counter()`` / ``ErrorCounters()`` / ``defaultdict(int|float)``,
+    or an UPPER_CASE dict/list global whose name says counter/stats."""
+    tree = ast.parse(open(path).read())
+    rel = os.path.relpath(path, os.path.dirname(PKG))
+    issues = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names:
+            continue
+        smells = None
+        if isinstance(value, ast.Call):
+            fn = value.func
+            callee = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else getattr(fn, "id", "")
+            )
+            if callee in _COUNTERISH_CALLS:
+                smells = f"{callee}(...)"
+        if smells is None and isinstance(value, (ast.Dict, ast.List)):
+            if any(
+                n.isupper() and n.lower().endswith(_COUNTERISH_NAMES)
+                for n in names
+            ):
+                smells = "counter-named global"
+        if smells is None:
+            continue
+        for n in names:
+            key = f"{rel}:{n}"
+            if key not in COUNTER_ALLOWLIST:
+                issues.append(
+                    f"{path}:{node.lineno}: module-level counter {n!r} "
+                    f"({smells}) — register it on the server's "
+                    "MetricsRegistry (predictionio_tpu/obs) instead"
+                )
+    return issues
+
+
+def test_no_adhoc_module_level_counters():
+    obs_dir = os.path.join(PKG, "obs")
+    issues = [
+        issue
+        for path in iter_modules()
+        if not path.startswith(obs_dir)
+        for issue in module_level_counters(path)
+    ]
+    assert not issues, "\n".join(issues)

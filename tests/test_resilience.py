@@ -693,3 +693,111 @@ class TestBatcherDeadlines:
             assert mb.stats()["expired_dropped"] == 0
         finally:
             mb.stop()
+
+
+# -- telemetry under chaos (obs/): the metrics you'd watch an outage with ----
+
+
+class TestTelemetryUnderChaos:
+    def test_breaker_metrics_walk_closed_open_halfopen(self, served):
+        """The pio_storage_client_* series must track the breaker's real
+        state machine under a fault shim: 0 → 1 → 2 → 0, with the retry
+        counter and opens_total moving when they should."""
+        from predictionio_tpu.obs import bridges as obs_bridges
+        from predictionio_tpu.obs import metrics as obs_metrics
+
+        client = _net_client(
+            served["port"], RETRIES="2",
+            BREAKER_THRESHOLD="2", BREAKER_RESET_MS="200",
+        )
+        apps = client.get_meta_data_apps()
+        reg = obs_metrics.MetricsRegistry()
+        obs_bridges.bridge_resilience(reg, client.resilience_stats)
+
+        def series():
+            return obs_metrics.parse_prometheus(reg.render_prometheus())
+
+        def gauge(name):
+            return series().get(
+                (f"pio_storage_client_{name}",
+                 (("endpoint", "/meta/apps"),))
+            )
+
+        # CLOSED: a healthy call creates the breaker, state reads 0
+        assert apps.get_all() == []
+        assert gauge("breaker_state") == 0
+        assert series()[("pio_storage_client_retries_total", ())] == 0
+
+        # persistent 503s: RETRIES=2 means one failing call burns two
+        # attempts — threshold 2 trips the breaker OPEN on the spot
+        faults.install(faults.FaultPlan(
+            [_rule(site="client:storage:/meta/apps/*", kind="error",
+                   status=503)],
+            seed=11,
+        ))
+        with pytest.raises(NetworkStorageError):
+            apps.get_all()
+        assert gauge("breaker_state") == 1
+        assert gauge("breaker_opens_total") == 1
+        assert series()[("pio_storage_client_retries_total", ())] >= 1
+
+        # cooldown elapses; a slow probe holds the breaker in HALF_OPEN
+        # long enough for a scrape to see state 2 mid-flight
+        faults.clear()
+        faults.install(faults.FaultPlan(
+            [_rule(site="client:storage:/meta/apps/*", kind="latency",
+                   latency_ms=400, times=1)],
+            seed=12,
+        ))
+        time.sleep(0.25)
+        probe = threading.Thread(target=apps.get_all, daemon=True)
+        probe.start()
+        saw_half_open = False
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if gauge("breaker_state") == 2:
+                saw_half_open = True
+                break
+            time.sleep(0.01)
+        probe.join(5.0)
+        assert saw_half_open, "scrape never observed HALF_OPEN"
+        # probe succeeded → CLOSED again, and the trip count is history
+        assert gauge("breaker_state") == 0
+        assert gauge("breaker_opens_total") == 1
+
+    def test_metrics_keeps_serving_while_degraded(self, trained):
+        """/metrics must answer — and show the degradation — while the
+        scorer is down and queries are being served from the fallback."""
+        from predictionio_tpu.obs import metrics as obs_metrics
+
+        qs = QueryServer(
+            trained["engine"], storage=trained["storage"],
+            ctx=trained["ctx"],
+        )
+        port = qs.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            status, _, _ = _call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 2}
+            )
+            assert status == 200  # warm: _last_good is populated
+            algo = qs._deployed.algorithms[0]
+            algo.predict = lambda m, q: (_ for _ in ()).throw(
+                RuntimeError("scorer down")
+            )
+            for _ in range(3):
+                status, body, _ = _call(
+                    "POST", base + "/queries.json", {"user": "u2", "num": 2}
+                )
+                assert status == 200 and body["degraded"] is True
+            with urllib.request.urlopen(base + "/metrics") as r:
+                assert r.status == 200
+                text = r.read().decode()
+            series = obs_metrics.parse_prometheus(text)
+            assert series[
+                ("pio_query_errors_total", (("kind", "degraded"),))
+            ] == 3
+            # the exposition itself stays whole mid-outage
+            assert len(series) >= 25
+        finally:
+            qs.stop()

@@ -32,7 +32,7 @@ OUT = os.path.join(REPO, "BENCH_TPU_MANUAL.json")
 # extras (serving latency, solver A/B, measured utilization).
 _PIN = {"BENCH_REBALANCE": "1", "BENCH_DTYPE": "f32"}
 _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
-         "BENCH_INGEST": "0"}
+         "BENCH_INGEST": "0", "BENCH_OBS": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -136,6 +136,17 @@ def main() -> int:
         "avg_flush_batch": ingest.get("avg_flush_batch"),
         "flush_errors": ingest.get("flush_errors"),
     }
+    # telemetry overhead gate from the primary cell: p50 with every request
+    # traced vs telemetry compiled out — `gate_pass: false` means the obs
+    # subsystem is taxing the hot loop beyond its <3% budget
+    obs = primary.get("observability") or {}
+    artifact["observability"] = {
+        "overhead_ratio": obs.get("overhead_ratio"),
+        "gate_pass": obs.get("gate_pass"),
+        "p50_on_ms": obs.get("p50_on_ms"),
+        "p50_off_ms": obs.get("p50_off_ms"),
+        "metric_series": obs.get("metric_series"),
+    }
     with open(final, "w") as f:
         json.dump(artifact, f, indent=1)
     print(json.dumps({
@@ -145,6 +156,7 @@ def main() -> int:
         **serving,
         "resilience": resilience,
         "ingest": artifact["ingest"],
+        "observability": artifact["observability"],
     }))
     return 0 if all_tpu else 1
 
