@@ -717,6 +717,127 @@ def _ingest_bench() -> dict:
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _durability_bench() -> dict:
+    """Durability cost evidence: fast-ack throughput with the ingest WAL
+    at each fsync policy (off / group / always), plus replay speed.
+
+    The acceptance gate is ``group_vs_off`` — the group-commit fsync
+    policy must hold within 2x of no-fsync, which is the whole point of
+    amortizing the fsync across the group window.  Replay is timed
+    separately (journal ~10k events, then replay + batch-insert into a
+    cold store) and normalized to seconds per 10k events.
+    """
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.data.api.ingest_buffer import (
+        IngestBuffer, wal_decode, wal_encode,
+    )
+    from predictionio_tpu.data.api.wal import WriteAheadLog
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.data.storage.sqlite import close_db
+
+    n = int(os.environ.get("BENCH_DURABILITY_EVENTS", 3000))
+    n_replay = int(os.environ.get("BENCH_DURABILITY_REPLAY_EVENTS", 10000))
+
+    def make_events(tag, count):
+        return [
+            Event(
+                event="rate", entity_type="user",
+                entity_id=f"{tag}u{i}", target_entity_type="item",
+                target_entity_id=f"i{i % 97}",
+                properties={"rating": float(i % 5 + 1)},
+            )
+            for i in range(count)
+        ]
+
+    throughput: dict[str, float] = {}
+    for policy in ("off", "group", "always"):
+        tmp = tempfile.mkdtemp(prefix=f"pio-dur-bench-{policy}-")
+        src = "DURBENCH"
+        path = os.path.join(tmp, "events.sqlite")
+        storage = Storage(env={
+            f"PIO_STORAGE_SOURCES_{src}_TYPE": "sqlite",
+            f"PIO_STORAGE_SOURCES_{src}_PATH": path,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+        })
+        try:
+            le = storage.get_l_events()
+            le.init(1)
+            wal = WriteAheadLog(os.path.join(tmp, "wal"), fsync=policy)
+            # fast-ack: the WAL append inside submit() is the ack's
+            # durability cost, so the submit loop's wall time IS the
+            # client-visible fast-ack throughput under that policy
+            buf = IngestBuffer(le, flush_ms=2.0, durable_ack=False, wal=wal)
+            evs = make_events(policy, n)
+            tickets = []
+            t0 = time.perf_counter()
+            for e in evs:
+                tickets.append(buf.submit(e, 1))
+            dt = time.perf_counter() - t0
+            throughput[policy] = n / dt
+            for t in tickets:
+                t.wait(30.0)
+            buf.close()
+            wal.close()
+        finally:
+            try:
+                close_db(path)
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+
+    # replay: journal n_replay events, then cold-start replay them into a
+    # fresh store the way the event server does on restart
+    tmp = tempfile.mkdtemp(prefix="pio-dur-bench-replay-")
+    src = "DURBENCH"
+    path = os.path.join(tmp, "events.sqlite")
+    storage = Storage(env={
+        f"PIO_STORAGE_SOURCES_{src}_TYPE": "sqlite",
+        f"PIO_STORAGE_SOURCES_{src}_PATH": path,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+    })
+    try:
+        wal = WriteAheadLog(os.path.join(tmp, "wal"), fsync="off")
+        for e in make_events("replay", n_replay):
+            wal.append(wal_encode(e, 1, None))
+        wal.close()
+
+        le = storage.get_l_events()
+        le.init(1)
+        wal2 = WriteAheadLog(os.path.join(tmp, "wal"), fsync="off")
+        t0 = time.perf_counter()
+        records = wal2.replay()
+        events = [wal_decode(p)[0] for p in records]
+        le.insert_batch(events, 1)
+        wal2.reclaim_replayed()
+        replay_dt = time.perf_counter() - t0
+        wal2.close()
+        replayed = len(records)
+    finally:
+        try:
+            close_db(path)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "backend": "sqlite",
+        "events": n,
+        "fast_ack_events_per_sec": {
+            k: round(v, 1) for k, v in throughput.items()
+        },
+        # acceptance: group-commit fsync within 2x of no fsync
+        "group_vs_off": round(throughput["off"] / throughput["group"], 2),
+        "always_vs_off": round(throughput["off"] / throughput["always"], 2),
+        "replay_events": replayed,
+        "replay_sec_per_10k": round(replay_dt * 10000.0 / max(replayed, 1), 3),
+    }
+
+
 def main() -> None:
     # BENCH_PLATFORM=cpu skips the (slow) tunnel probe for local iteration
     forced_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
@@ -876,6 +997,14 @@ def main() -> None:
             print(f"WARNING: ingest bench failed: {e}", file=sys.stderr)
             ingest = {"error": str(e)}
         print(f"INFO: ingest: {ingest}", file=sys.stderr)
+    durability = None
+    if os.environ.get("BENCH_DURABILITY", "1") != "0":
+        try:
+            durability = _durability_bench()
+        except Exception as e:  # durability bench must never kill the artifact
+            print(f"WARNING: durability bench failed: {e}", file=sys.stderr)
+            durability = {"error": str(e)}
+        print(f"INFO: durability: {durability}", file=sys.stderr)
     observability = None
     if os.environ.get("BENCH_OBS", "1") != "0":
         try:
@@ -916,6 +1045,8 @@ def main() -> None:
             record["resilience"] = http_res
     if ingest is not None:
         record["ingest"] = ingest
+    if durability is not None:
+        record["durability"] = durability
     if observability is not None:
         record["observability"] = observability
     if "zipf" in results and primary_dist != "zipf":

@@ -11,6 +11,12 @@ SEEDED schedule, at named fault SITES compiled into the service planes:
   before each HTTP call (latency / simulated drop / simulated 5xx).
 * ``client:storage:frames:<path>`` — consulted per frame of a framed bulk
   pull (truncation mid-stream).
+* ``crash:<subsystem>:<point>`` — consulted by :func:`crash_point` calls
+  compiled into durability-critical code paths (e.g.
+  ``crash:ingest:before_flush_commit``, ``crash:modeldata:mid_write``).
+  A matching ``crash`` rule hard-kills the process with ``os._exit(137)``
+  — no atexit hooks, no flushes, the same observable death as ``kill -9``
+  — so recovery tests exercise real torn state rather than mocks.
 
 Nothing fires unless a plan is installed — the shim is one ``is None``
 check on the hot path.  Installation is programmatic (:func:`install`,
@@ -32,7 +38,11 @@ import threading
 from dataclasses import dataclass
 from typing import Optional
 
-KINDS = ("latency", "error", "drop", "truncate")
+KINDS = ("latency", "error", "drop", "truncate", "crash")
+
+# 128 + SIGKILL: the exit code a shell reports for a kill -9 death, so a
+# test harness can't tell an injected crash from a real one.
+CRASH_EXIT_CODE = 137
 
 
 @dataclass(frozen=True)
@@ -174,6 +184,26 @@ def check(site: str) -> Optional[FaultAction]:
     if plan is None:
         return None
     return plan.on_call(site)
+
+
+def crash_point(site: str) -> None:
+    """A compiled-in process-death site: one ``is None`` check when chaos
+    is off; with a matching ``crash`` rule installed, ``os._exit(137)`` —
+    bypassing atexit handlers, finally blocks, and buffered-IO flushes, so
+    whatever was mid-write stays torn exactly as a SIGKILL would leave it.
+
+    Rules of other kinds matching a crash site are ignored (a latency rule
+    can't meaningfully delay a death), but they still consume their
+    ordinal — the schedule stays deterministic either way.
+    """
+    plan = active()
+    if plan is None:
+        return
+    act = plan.on_call(site)
+    if act is not None and act.kind == "crash":
+        import os
+
+        os._exit(CRASH_EXIT_CODE)
 
 
 def parse_spec(spec: str) -> list[FaultRule]:

@@ -18,9 +18,48 @@ Parity: ``controller/PersistentModel.scala`` + ``BaseAlgorithm.makePersistentMod
 from __future__ import annotations
 
 import abc
+import hashlib
 import importlib
 import pickle
 from typing import Any
+
+# Content-checksum envelope around the MODELDATA blob: magic + version +
+# sha256(payload) + payload. Deploy verifies the digest before unpickling,
+# turning a torn or bit-flipped blob into a clean ModelIntegrityError the
+# server can degrade on (last-known-good) instead of a pickle crash deep
+# in deserialization. Pickles start with b"\x80", so legacy un-enveloped
+# blobs can never collide with the magic and keep loading as-is.
+_ENVELOPE_MAGIC = b"PIOM1"
+_DIGEST_LEN = 32  # sha256
+
+
+class ModelIntegrityError(Exception):
+    """The stored model blob fails its content checksum (torn write,
+    media corruption); the blob must not be deserialized."""
+
+
+def seal_model_blob(payload: bytes) -> bytes:
+    """Wrap a serialized-models payload in the checksum envelope."""
+    return _ENVELOPE_MAGIC + hashlib.sha256(payload).digest() + payload
+
+
+def open_model_blob(blob: bytes) -> bytes:
+    """Verify and strip the envelope; raises :class:`ModelIntegrityError`
+    on digest mismatch. Legacy blobs (no magic) pass through unchanged."""
+    if not blob.startswith(_ENVELOPE_MAGIC):
+        return blob
+    header_len = len(_ENVELOPE_MAGIC) + _DIGEST_LEN
+    if len(blob) < header_len:
+        raise ModelIntegrityError(
+            f"model blob shorter than its envelope header ({len(blob)} bytes)"
+        )
+    digest = blob[len(_ENVELOPE_MAGIC):header_len]
+    payload = blob[header_len:]
+    if hashlib.sha256(payload).digest() != digest:
+        raise ModelIntegrityError(
+            "model blob checksum mismatch (torn write or corruption)"
+        )
+    return payload
 
 
 class _RetrainSentinel:

@@ -154,8 +154,10 @@ def run_train(
             instance_id, algorithms, models, algo_params
         )
         if writer:
+            # checksum envelope: deploy verifies content integrity before
+            # unpickling, so a torn blob degrades instead of crashing
             storage.get_model_data_models().insert(
-                Model(id=instance_id, models=blob)
+                Model(id=instance_id, models=persistence.seal_model_blob(blob))
             )
     except BaseException:
         # no zombie TRAINING rows: mark the run aborted, then propagate
@@ -205,8 +207,11 @@ def prepare_deploy(
     model_row = storage.get_model_data_models().get(instance.id)
     if model_row is None:
         raise RuntimeError(f"no model blob for engine instance {instance.id}")
+    # raises ModelIntegrityError on a torn/corrupt blob — callers with an
+    # older generation (query server last-known-good) degrade to it
+    blob = persistence.open_model_blob(model_row.models)
     models, retrain_idx = persistence.deserialize_models(
-        model_row.models, instance.id, algorithms, algo_params, ctx
+        blob, instance.id, algorithms, algo_params, ctx
     )
     if retrain_idx:
         # Unit-model mode: retrain ONLY those slots (Engine.scala:210-232);

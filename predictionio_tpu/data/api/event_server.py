@@ -22,11 +22,18 @@ from __future__ import annotations
 import base64
 import logging
 import os
+import threading
+import time
 from typing import Optional
 
 from predictionio_tpu import obs
 from predictionio_tpu.common.http import HttpService, Request, Response, json_response
-from predictionio_tpu.data.api.ingest_buffer import BufferFull, IngestBuffer
+from predictionio_tpu.data.api.ingest_buffer import (
+    BufferFull,
+    IngestBuffer,
+    wal_decode,
+)
+from predictionio_tpu.data.api.wal import WriteAheadLog
 from predictionio_tpu.data.api.stats import Stats
 from predictionio_tpu.obs import bridges as _bridges
 from predictionio_tpu.data.event import Event, parse_time_or_none
@@ -73,6 +80,8 @@ class EventServer:
         ingest_flush_ms: Optional[float] = None,
         ingest_buffer_max: Optional[int] = None,
         telemetry: bool = True,
+        wal_dir: Optional[str] = None,
+        drain_timeout_ms: Optional[float] = None,
     ):
         self.storage = storage or Storage.instance()
         self.stats_enabled = stats
@@ -91,6 +100,22 @@ class EventServer:
                 f"ingest mode must be off|durable|fast, got {mode!r}"
             )
         self.ingest_mode = mode
+        self.drain_timeout_ms = (
+            drain_timeout_ms if drain_timeout_ms is not None
+            else _env_num("PIO_DRAIN_TIMEOUT_MS", 5000.0, float)
+        )
+        self._draining = False
+        self._drain_counts = {"drains": 0, "drained_events": 0,
+                              "abandoned_events": 0}
+        self._stopped = False
+        # fast-ack WAL: journaled-before-202, replayed on startup — closes
+        # the crash window the fast mode's docstring used to concede
+        self.wal: Optional[WriteAheadLog] = None
+        self.wal_replayed = 0
+        wal_dir = wal_dir if wal_dir is not None else os.environ.get("PIO_WAL_DIR")
+        if mode == "fast" and wal_dir:
+            self.wal = WriteAheadLog(wal_dir)
+            self.wal_replayed = self._replay_wal()
         self.ingest_buffer: Optional[IngestBuffer] = None
         if mode != "off":
             self.ingest_buffer = IngestBuffer(
@@ -104,6 +129,7 @@ class EventServer:
                     else _env_num("PIO_INGEST_BUFFER_MAX", 10_000, int)
                 ),
                 durable_ack=(mode == "durable"),
+                wal=self.wal,
             )
         self.service = HttpService("eventserver")
         # unified observability (obs/): /metrics + /trace/recent.json, and
@@ -116,6 +142,45 @@ class EventServer:
         if self.telemetry is not None:
             self._register_metrics()
         self._register_routes()
+
+    def _replay_wal(self) -> int:
+        """Re-insert whatever a previous incarnation journaled but never
+        flush-committed. Ids were pinned at submit time, so replaying a
+        record whose flush actually landed rewrites the same row.
+
+        A replay that can't reach storage keeps its segments on disk for
+        the next restart — availability over amnesia.
+        """
+        records = self.wal.replay()
+        if not records:
+            return 0
+        groups: dict[tuple, list] = {}
+        bad = 0
+        for payload in records:
+            try:
+                event, app_id, channel_id = wal_decode(payload)
+            except Exception:
+                bad += 1
+                continue
+            groups.setdefault((app_id, channel_id), []).append(event)
+        le = self.storage.get_l_events()
+        replayed = 0
+        try:
+            for (app_id, channel_id), events in groups.items():
+                le.init(app_id, channel_id)
+                le.insert_batch(events, app_id, channel_id)
+                replayed += len(events)
+        except Exception:
+            logger.exception(
+                "WAL replay failed after %d events; segments retained for "
+                "the next startup", replayed
+            )
+            return replayed
+        self.wal.reclaim_replayed()
+        if bad:
+            logger.warning("WAL replay skipped %d undecodable records", bad)
+        logger.info("WAL replay restored %d fast-acked events", replayed)
+        return replayed
 
     def _register_metrics(self) -> None:
         reg = self.telemetry.registry
@@ -132,6 +197,26 @@ class EventServer:
         )
         if self.ingest_buffer is not None:
             _bridges.bridge_ingest_buffer(reg, self.ingest_buffer.stats)
+        reg.gauge_fn(
+            "pio_draining",
+            "1 while the server is draining toward shutdown.",
+            lambda: 1.0 if self._draining else 0.0,
+        )
+        reg.gauge_fn(
+            "pio_drain_drained_events",
+            "Buffered events flushed to storage by graceful drains.",
+            lambda: float(self._drain_counts["drained_events"]),
+        )
+        reg.gauge_fn(
+            "pio_drain_abandoned_events",
+            "Buffered events abandoned when a drain budget lapsed.",
+            lambda: float(self._drain_counts["abandoned_events"]),
+        )
+        reg.gauge_fn(
+            "pio_wal_replayed_on_start",
+            "Fast-acked events restored from the WAL at startup.",
+            lambda: float(self.wal_replayed),
+        )
         # a network-backed storage carries the retry/breaker client; its
         # resilience state belongs on this server's exposition
         storage_rs = getattr(self.storage, "resilience_stats", None)
@@ -349,8 +434,32 @@ class EventServer:
         def index(req):
             return json_response(200, {"status": "alive"})
 
+        @svc.route("GET", r"/healthz")
+        def healthz(req):
+            # liveness: the process answers; draining is still alive
+            return json_response(200, {"status": "ok"})
+
+        @svc.route("GET", r"/readyz")
+        def readyz(req):
+            # readiness: a draining server tells the balancer to route away
+            # while in-flight work finishes
+            if self._draining:
+                return json_response(503, {"status": "draining"})
+            return json_response(200, {"status": "ready"})
+
+        @svc.route("POST", r"/stop")
+        def stop_route(req):
+            # graceful drain off the request thread: flip readiness, flush
+            # the buffer/WAL, then stop listening
+            threading.Thread(
+                target=self._delayed_drain, daemon=True
+            ).start()
+            return json_response(202, {"message": "draining"})
+
         @svc.route("POST", r"/events\.json")
         def create_event(req):
+            if self._draining:
+                return self._draining_response()
             auth, err = self._authenticate(req)
             if err:
                 return err
@@ -431,6 +540,8 @@ class EventServer:
         def batch_events(req):
             # partial-success semantics (parity: EventServer.scala:340-419);
             # one auth + one grouped insert_batch, per-item statuses
+            if self._draining:
+                return self._draining_response()
             auth, err = self._authenticate(req)
             if err:
                 return err
@@ -454,7 +565,12 @@ class EventServer:
                 return err
             if self.ingest_buffer is None:
                 return json_response(200, {"mode": "off"})
-            return json_response(200, self.ingest_buffer.stats())
+            out = self.ingest_buffer.stats()
+            out["drain"] = dict(self._drain_counts)
+            if self.wal is not None:
+                out.setdefault("wal", self.wal.stats())
+                out["wal"]["replayed_on_start"] = self.wal_replayed
+            return json_response(200, out)
 
         @svc.route("GET", r"/stats\.json")
         def stats_route(req):
@@ -526,12 +642,59 @@ class EventServer:
         logger.info("event server listening on %s:%s", host, actual)
         return actual
 
-    def stop(self) -> None:
-        # stop accepting first, then drain the buffer: every acked event
-        # is flushed before shutdown returns
-        self.service.stop()
+    def _draining_response(self) -> Response:
+        return Response(
+            503,
+            {"message": "server draining; retry against another instance"},
+            headers={"Retry-After": "1"},
+        )
+
+    def _delayed_drain(self) -> None:
+        # let the POST /stop response leave the socket before teardown
+        time.sleep(0.3)
+        self.drain()
+
+    def drain(self, timeout_ms: Optional[float] = None) -> bool:
+        """Graceful shutdown: reject new writes, flush the buffer and WAL
+        within the budget, then stop listening. Returns True when nothing
+        was abandoned.
+        """
+        budget_s = (
+            timeout_ms if timeout_ms is not None else self.drain_timeout_ms
+        ) / 1e3
+        self._draining = True
+        self._drain_counts["drains"] += 1
+        clean = True
         if self.ingest_buffer is not None:
-            self.ingest_buffer.close()
+            before = self.ingest_buffer.stats()["buffered"]
+            drained = self.ingest_buffer.close(timeout=max(budget_s, 0.0))
+            left = self.ingest_buffer.stats()["buffered"]
+            self._drain_counts["drained_events"] += max(before - left, 0)
+            if not drained or left:
+                self._drain_counts["abandoned_events"] += left
+                logger.warning(
+                    "drain budget (%.0fms) lapsed with %d events unflushed",
+                    budget_s * 1e3, left,
+                )
+                clean = False
+        if self.wal is not None:
+            self.wal.close()
+        le_close = getattr(self.storage.get_l_events(), "close", None)
+        if callable(le_close):
+            try:
+                le_close()
+            except Exception:
+                logger.exception("LEvents close failed during drain")
+        self.service.stop()
+        self._stopped = True
+        return clean
+
+    def stop(self) -> None:
+        """Shutdown with the full drain semantics: every acked event is
+        flushed (budget permitting) before this returns."""
+        if self._stopped:
+            return
+        self.drain()
 
 
 def register_builtin_connectors() -> None:

@@ -16,8 +16,11 @@ Durability contract (two ack modes):
   on storage. Latency is bounded by one flush interval + commit time,
   throughput by events-per-flush.
 * **fast-ack** — the caller is acked as soon as the event is buffered
-  (202 at the HTTP layer); a crash between ack and flush can lose up to
-  one buffer of events. Opt-in, for firehose ingestion.
+  (202 at the HTTP layer). With a :class:`~predictionio_tpu.data.api.wal
+  .WriteAheadLog` attached the event is journaled *before* the ack and
+  replayed on the next startup, so a crash between ack and flush loses
+  nothing (modulo the WAL's fsync policy); without one, a crash can lose
+  up to one buffer of events. Opt-in, for firehose ingestion.
 
 Exactly-once under retry: event ids are assigned at ``submit`` time, so a
 flush retried under the resilience policy (PR 2) re-writes the SAME rows
@@ -31,10 +34,11 @@ standard 503 + ``Retry-After`` shedding contract.
 
 from __future__ import annotations
 
+import json
 import threading
 from typing import Optional
 
-from predictionio_tpu.common import resilience
+from predictionio_tpu.common import faults, resilience
 from predictionio_tpu.data.event import Event, new_event_id
 
 DEFAULT_FLUSH_MS = 5.0
@@ -58,6 +62,23 @@ def _flush_retryable(exc: BaseException) -> bool:
     return True
 
 
+def wal_encode(event: Event, app_id: int, channel_id: Optional[int]) -> bytes:
+    """One WAL record payload: routing key + the full event JSON (the
+    event id is already pinned, making replay idempotent)."""
+    return json.dumps({
+        "appId": app_id,
+        "channelId": channel_id,
+        "event": event.to_dict(),
+    }, separators=(",", ":")).encode("utf-8")
+
+
+def wal_decode(payload: bytes) -> tuple[Event, int, Optional[int]]:
+    """Inverse of :func:`wal_encode`; raises on malformed payloads (the
+    WAL's crc already rejects torn records, this guards logic bugs)."""
+    d = json.loads(payload.decode("utf-8"))
+    return Event.from_dict(d["event"]), d["appId"], d.get("channelId")
+
+
 class BufferFull(Exception):
     """The bounded buffer is at capacity; callers should shed (503)."""
 
@@ -70,11 +91,12 @@ class BufferFull(Exception):
 class Ticket:
     """One submitted event's ack handle; ``event_id`` is final at submit."""
 
-    __slots__ = ("event_id", "error", "_done")
+    __slots__ = ("event_id", "error", "wal_seq", "_done")
 
     def __init__(self, event_id: str):
         self.event_id = event_id
         self.error: Optional[BaseException] = None
+        self.wal_seq: Optional[int] = None  # journal handle, commit on flush
         self._done = threading.Event()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -98,8 +120,10 @@ class IngestBuffer:
         durable_ack: bool = True,
         retry_policy: Optional[resilience.RetryPolicy] = None,
         name: str = "ingest",
+        wal=None,
     ):
         self._le = le
+        self.wal = wal  # WriteAheadLog, journals fast-acked events
         self.flush_interval_s = max(0.0, float(flush_ms)) / 1e3
         self.buffer_max = int(buffer_max)
         self.max_batch = max(1, int(max_batch))
@@ -142,9 +166,15 @@ class IngestBuffer:
                 raise BufferFull(self.buffer_max, self.flush_interval_s)
             eid = event.event_id or new_event_id()
             ticket = Ticket(eid)
-            self._queue.append(
-                ((app_id, channel_id), event.with_id(eid), ticket)
-            )
+            pinned = event.with_id(eid)
+            # journal BEFORE the ack can leave this call and BEFORE the
+            # flusher can commit the ticket — the id is already pinned, so
+            # replay after a crash that raced a flush is idempotent
+            if self.wal is not None and not self.durable_ack:
+                ticket.wal_seq = self.wal.append(wal_encode(
+                    pinned, app_id, channel_id
+                ))
+            self._queue.append(((app_id, channel_id), pinned, ticket))
             self._counts["accepted"] += 1
             # wake the flusher when a coalescing window should start (first
             # event in) or when the size threshold says "flush now"
@@ -169,6 +199,9 @@ class IngestBuffer:
             self._flush(batch)
 
     def _flush(self, batch: list[tuple[tuple, Event, Ticket]]) -> None:
+        # events here are acked (fast mode) but not yet on storage — dying
+        # now is the exact loss the WAL exists to repair via replay
+        faults.crash_point("crash:ingest:before_flush")
         groups: dict[tuple, list[tuple[Event, Ticket]]] = {}
         for key, event, ticket in batch:
             groups.setdefault(key, []).append((event, ticket))
@@ -185,11 +218,21 @@ class IngestBuffer:
                     on_retry=self._note_retry,
                 )
             except BaseException as e:
+                # journaled records are NOT committed: the next startup
+                # replays them, which is the durability promise
                 with self._cv:
                     self._counts["flush_errors"] += 1
                 for _, ticket in items:
                     ticket.resolve(e)
                 continue
+            # the storage write landed but the journal still holds the
+            # records — the window the kill-9 chaos test aims at (replay
+            # re-writes the same ids, so dying here duplicates nothing)
+            faults.crash_point("crash:ingest:before_flush_commit")
+            if self.wal is not None:
+                for _, ticket in items:
+                    if ticket.wal_seq is not None:
+                        self.wal.commit(ticket.wal_seq)
             with self._cv:
                 self._counts["flushes"] += 1
                 self._counts["flushed"] += len(items)
@@ -205,17 +248,27 @@ class IngestBuffer:
             self._counts["retries"] += 1
 
     # -- lifecycle / observability -------------------------------------------
-    def close(self, timeout: Optional[float] = 30.0) -> None:
-        """Stop accepting, flush everything buffered, join the flusher."""
+    def close(self, timeout: Optional[float] = 30.0) -> bool:
+        """Stop accepting, flush everything buffered, join the flusher.
+
+        Returns True when the flusher drained and exited inside the
+        timeout — the drain path's "nothing abandoned" signal. The WAL,
+        if any, is synced but left open; its owner closes it (replay of a
+        synced-but-uncommitted record is harmless).
+        """
         with self._cv:
             self._closed = True
             self._cv.notify_all()
         self._thread.join(timeout)
+        drained = not self._thread.is_alive()
+        if self.wal is not None:
+            self.wal.sync()
+        return drained
 
     def stats(self) -> dict:
         with self._cv:
             flushes = self._counts["flushes"]
-            return {
+            out = {
                 "mode": "durable" if self.durable_ack else "fast",
                 "flush_ms": round(self.flush_interval_s * 1e3, 3),
                 "buffer_max": self.buffer_max,
@@ -227,3 +280,6 @@ class IngestBuffer:
                 ),
                 "flush_batch_hist": dict(self._hist),
             }
+        if self.wal is not None:
+            out["wal"] = self.wal.stats()
+        return out

@@ -13,6 +13,7 @@ import os
 from typing import Optional
 
 from predictionio_tpu.data.storage import base
+from predictionio_tpu.utils.fs import atomic_write
 
 
 class LocalFSModels(base.Models):
@@ -34,10 +35,14 @@ class LocalFSModels(base.Models):
         return os.path.join(self._dir, safe)
 
     def insert(self, model: base.Model) -> None:
-        tmp = self._path(model.id) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(model.models)
-        os.replace(tmp, self._path(model.id))
+        # write-temp → fsync → rename: a crash mid-publish leaves the
+        # previous generation intact, never a torn blob under the live
+        # name. The crash site lets chaos tests die with half a temp file.
+        atomic_write(
+            self._path(model.id),
+            model.models,
+            crash_site="crash:modeldata:mid_write",
+        )
 
     def get(self, model_id: str):
         p = self._path(model_id)

@@ -136,6 +136,20 @@ class _Db:
                 self._writer.close()
                 self._writer = None
 
+    def checkpoint(self) -> None:
+        """TRUNCATE-checkpoint the WAL so a restarted process opens a
+        settled database instead of recovering a large ``-wal`` file.
+        Best-effort: a concurrent reader holding the WAL back just means a
+        smaller-than-full checkpoint.
+        """
+        if self.path == ":memory:":
+            return
+        try:
+            with self.lock:
+                self.conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+        except sqlite3.Error:
+            pass
+
 
 def get_db(path: str) -> _Db:
     key = os.path.abspath(path) if path != ":memory:" else ":memory:"
@@ -163,6 +177,7 @@ def close_db(path_or_db) -> None:
             _CONNS.pop(key)
     if db is not None:
         db.close_writer()
+        db.checkpoint()
         with db.lock:
             db.conn.close()
 
@@ -173,6 +188,7 @@ def close_all_dbs() -> None:
         _CONNS.clear()
     for db in dbs:
         db.close_writer()
+        db.checkpoint()
         with db.lock:
             db.conn.close()
 
@@ -346,9 +362,13 @@ class SqliteLEvents(_SqliteDAO, base.LEvents):
         return True
 
     def close(self) -> None:
-        # Connection lifecycle is owned by the module-level cache: other DAOs
-        # share this _Db, so per-DAO close is a no-op. Use close_db/close_all_dbs.
-        pass
+        # The shared connection's lifecycle is owned by the module-level
+        # cache (other DAOs still read through it), but the ingest writer
+        # is this DAO's: close it and checkpoint the WAL so a restarted
+        # event server opens a settled database rather than stalling on a
+        # stale -wal recovery. The writer reopens lazily on next use.
+        self._db.close_writer()
+        self._db.checkpoint()
 
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         eid = event.event_id or new_event_id()

@@ -359,6 +359,45 @@ def bridge_ingest_buffer(
                     ],
                 )
             )
+        wal = s.get("wal")
+        if isinstance(wal, dict):
+            fams.extend([
+                _fam(
+                    "pio_wal_depth", "gauge",
+                    "WAL records journaled but not yet flush-committed.",
+                    [("", (), _num(wal.get("depth")))],
+                ),
+                _fam(
+                    "pio_wal_segments", "gauge",
+                    "WAL segment files currently on disk.",
+                    [("", (), _num(wal.get("segments")))],
+                ),
+                _fam(
+                    "pio_wal_records_total", "counter",
+                    "WAL record flow (appended / committed / replayed).",
+                    [
+                        ("", (("op", "appended"),), _num(wal.get("appended"))),
+                        ("", (("op", "committed"),),
+                         _num(wal.get("committed"))),
+                        ("", (("op", "replayed"),), _num(wal.get("replayed"))),
+                    ],
+                ),
+                _fam(
+                    "pio_wal_syncs_total", "counter",
+                    "fsync calls issued by the WAL (policy-dependent).",
+                    [("", (), _num(wal.get("synced")))],
+                ),
+                _fam(
+                    "pio_wal_truncated_tails_total", "counter",
+                    "Torn segment tails truncated during replay.",
+                    [("", (), _num(wal.get("truncated_tails")))],
+                ),
+                _fam(
+                    "pio_wal_reclaimed_segments_total", "counter",
+                    "Fully-committed segments reclaimed (unlinked).",
+                    [("", (), _num(wal.get("reclaimed_segments")))],
+                ),
+            ])
         return fams
 
     registry.register_collector(collect)

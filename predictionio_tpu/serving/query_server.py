@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import queue
 import secrets
 import threading
@@ -180,7 +181,14 @@ class QueryServer:
         self.counters = ErrorCounters(
             "shed", "deadline_exceeded", "breaker_open", "degraded",
             "query_errors", "warmup_errors", "sniffer_errors",
-            "feedback_errors", "reload_failed",
+            "feedback_errors", "reload_failed", "drained",
+            "drain_abandoned",
+        )
+        # graceful drain (SIGTERM / POST /stop): /readyz flips to draining,
+        # new queries shed, in-flight work finishes inside the budget
+        self._draining = False
+        self.drain_timeout_ms = float(
+            os.environ.get("PIO_DRAIN_TIMEOUT_MS", 5000.0)
         )
         self._rl_log = RateLimitedLogger(logger)
         # the feedback poster rides the shared retry/breaker policy: a dead
@@ -226,9 +234,12 @@ class QueryServer:
         blob, bad hot-swap) and a previous generation is live, the server
         KEEPS SERVING the last good generation — counted, flagged on
         ``/readyz`` and stats — instead of dying or swapping in garbage.
-        The initial deploy still fails loudly: there is nothing to fall
-        back to.
+        A COLD START whose newest blob is unusable falls back to the
+        persisted last-known-good pointer (then any older COMPLETED
+        generation); only a cold start with nothing deployable left fails
+        loudly.
         """
+        instance = None
         try:
             instance = get_latest_completed_instance(
                 self.storage, self.engine_id, self.engine_version,
@@ -240,15 +251,22 @@ class QueryServer:
         except Exception:
             with self._lock:
                 last_good = self._deployed
-            if last_good is None:
-                raise  # initial deploy: no generation to degrade to
-            self.counters.inc("reload_failed")
-            self._reload_degraded = True
-            self._rl_log.exception(
-                "reload", "reload failed; serving last good instance %s",
-                last_good.instance_id,
+            if last_good is not None:
+                self.counters.inc("reload_failed")
+                self._reload_degraded = True
+                self._rl_log.exception(
+                    "reload", "reload failed; serving last good instance %s",
+                    last_good.instance_id,
+                )
+                return last_good.instance_id
+            # cold start: nothing in memory to keep serving — reach for the
+            # on-disk last-known-good pointer, then older COMPLETED runs
+            fallback = self._cold_start_fallback(
+                failed_id=instance.id if instance is not None else None
             )
-            return last_good.instance_id
+            if fallback is None:
+                raise  # truly nothing deployable
+            return fallback.instance_id
         if self._warm_fastpath:
             # pre-compile the serving fast path at deploy/reload so no live
             # request ever pays trace/compile latency (ISSUE: AOT warmup)
@@ -274,8 +292,88 @@ class QueryServer:
         with self._lock:
             self._deployed = deployed
         self._reload_degraded = False
+        self._record_last_known_good(instance.id)
         logger.info("deployed engine instance %s", instance.id)
         return instance.id
+
+    # -- last-known-good pointer (survives restarts) -------------------------
+    def _lkg_path(self) -> str:
+        from predictionio_tpu.utils.fs import pio_base_dir
+
+        raw = f"{self.engine_id}-{self.engine_version}-{self.engine_variant}"
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in raw)
+        return os.path.join(pio_base_dir(), "last_known_good", safe + ".json")
+
+    def _record_last_known_good(self, instance_id: str) -> None:
+        """Persist the generation that just deployed successfully; a future
+        cold start with a torn newest blob deploys this one instead.
+        Best-effort: pointer write failure must never fail a deploy."""
+        from predictionio_tpu.utils.fs import atomic_write_text
+
+        path = self._lkg_path()
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            atomic_write_text(
+                path, json.dumps({"instanceId": instance_id})
+            )
+        except OSError:
+            logger.debug("last-known-good pointer write failed", exc_info=True)
+
+    def _read_last_known_good(self) -> Optional[str]:
+        try:
+            with open(self._lkg_path(), "r", encoding="utf-8") as f:
+                value = json.load(f).get("instanceId")
+            return value if isinstance(value, str) else None
+        except (OSError, ValueError):
+            return None
+
+    def _cold_start_fallback(self, failed_id: Optional[str]) -> Optional[_Deployed]:
+        """Deploy an older generation when the newest is unusable at cold
+        start: the persisted last-known-good pointer first, then every
+        other COMPLETED instance newest-first. Serving stale beats not
+        serving; the swap is flagged degraded on /readyz and counted."""
+        try:
+            completed = self.storage.get_meta_data_engine_instances().get_completed(
+                self.engine_id, self.engine_version, self.engine_variant
+            )
+        except Exception:
+            return None
+        by_id = {i.id: i for i in completed}
+        order: list[str] = []
+        lkg_id = self._read_last_known_good()
+        if lkg_id and lkg_id != failed_id and lkg_id in by_id:
+            order.append(lkg_id)
+        for inst in completed:
+            if inst.id != failed_id and inst.id not in order:
+                order.append(inst.id)
+        for iid in order:
+            try:
+                _, algorithms, serving, models = prepare_deploy(
+                    self.engine, by_id[iid], storage=self.storage, ctx=self.ctx
+                )
+            except Exception:
+                self._rl_log.exception(
+                    "reload", "fallback candidate %s failed to deploy", iid
+                )
+                continue
+            deployed = _Deployed(
+                instance_id=iid,
+                algorithms=algorithms,
+                serving=serving,
+                models=models,
+                start_time=time.time(),
+            )
+            with self._lock:
+                self._deployed = deployed
+            self.counters.inc("reload_failed")
+            self._reload_degraded = True
+            self._record_last_known_good(iid)
+            logger.warning(
+                "cold start: newest instance %s unusable; serving "
+                "last-known-good %s (degraded)", failed_id, iid,
+            )
+            return deployed
+        return None
 
     # -- observability -------------------------------------------------------
     def _fastpath_stats(self) -> Optional[dict]:
@@ -353,6 +451,9 @@ class QueryServer:
                   "1 while serving the last good generation after a "
                   "failed reload.",
                   [("", (), 1.0 if self._reload_degraded else 0.0)]),
+                F("pio_draining", "gauge",
+                  "1 while the server is draining toward shutdown.",
+                  [("", (), 1.0 if self._draining else 0.0)]),
             ]
 
         reg.register_collector(_serving_families)
@@ -620,7 +721,11 @@ class QueryServer:
                 "inflight": inflight,
                 "maxInflight": self.max_inflight,
                 "reloadDegraded": self._reload_degraded,
+                "draining": self._draining,
             }
+            if self._draining:
+                body["status"] = "draining"
+                return json_response(503, body)
             if not deployed:
                 body["status"] = "no engine instance deployed"
                 return json_response(503, body)
@@ -636,6 +741,14 @@ class QueryServer:
                 data = req.json()
             if not isinstance(data, dict):
                 return json_response(400, {"message": "query must be a JSON object"})
+            if self._draining:
+                # draining: in-flight work finishes, new work goes elsewhere
+                return Response(
+                    status=503,
+                    body={"message": "server draining; retry against "
+                          "another instance"},
+                    headers={"Retry-After": f"{self.shed_retry_after_s:g}"},
+                )
             # admission control: beyond max_inflight, queueing only adds
             # latency to requests that will miss their deadlines anyway —
             # shed with 503 + Retry-After so callers back off
@@ -678,7 +791,7 @@ class QueryServer:
         def stop_route(req: Request):
             def _stop():
                 time.sleep(0.3)  # let the response flush before the socket dies
-                self.service.stop()
+                self.drain()
 
             threading.Thread(target=_stop, daemon=True).start()
             return json_response(200, {"message": "Shutting down."})
@@ -708,6 +821,35 @@ class QueryServer:
         actual = self.service.start(host, port, **tls)
         logger.info("query server listening on %s:%s", host, actual)
         return actual
+
+    def drain(self, timeout_ms: Optional[float] = None) -> bool:
+        """Graceful shutdown: flip /readyz to draining (new queries shed),
+        wait for in-flight queries — including queued micro-batches — to
+        finish inside the budget, then stop. Returns True when nothing
+        was abandoned; abandoned work is counted either way."""
+        budget_s = (
+            timeout_ms if timeout_ms is not None else self.drain_timeout_ms
+        ) / 1e3
+        self._draining = True
+        deadline = time.monotonic() + max(budget_s, 0.0)
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                inflight = self._inflight
+            if inflight == 0:
+                break
+            time.sleep(0.005)
+        with self._inflight_lock:
+            abandoned = self._inflight
+        if abandoned:
+            self.counters.inc("drain_abandoned", abandoned)
+            logger.warning(
+                "drain budget (%.0fms) lapsed with %d queries in flight",
+                budget_s * 1e3, abandoned,
+            )
+        else:
+            self.counters.inc("drained")
+        self.stop()
+        return abandoned == 0
 
     def stop(self) -> None:
         if self._batcher is not None:

@@ -336,6 +336,22 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def _install_drain_handler(server) -> None:
+    """SIGTERM → graceful drain → clean exit (the orchestrator contract:
+    a TERM'd server finishes in-flight work inside PIO_DRAIN_TIMEOUT_MS
+    and exits 0, instead of dropping it on the floor)."""
+    import signal
+
+    def _term(signum, frame):
+        server.drain()
+        raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:
+        pass  # not the main thread (embedded use): skip
+
+
 def cmd_deploy(args) -> int:
     from predictionio_tpu.serving.query_server import QueryServer
 
@@ -361,12 +377,13 @@ def cmd_deploy(args) -> int:
     )
     port = qs.start(args.ip, args.port, cert_path=args.cert_path,
                     key_path=args.key_path)
+    _install_drain_handler(qs)
     print(f"[INFO] Engine is deployed and running. Engine API is live at "
           f"http://{args.ip}:{port}.")
     try:
         qs.service.serve_forever()
     except KeyboardInterrupt:
-        qs.stop()
+        qs.drain()
     return 0
 
 
@@ -446,9 +463,11 @@ def cmd_eventserver(args) -> int:
         ingest_mode=args.ingest_buffer,
         ingest_flush_ms=args.flush_ms,
         ingest_buffer_max=args.buffer_max,
+        wal_dir=args.wal_dir,
     )
     port = es.start(args.ip, args.port, cert_path=args.cert_path,
                     key_path=args.key_path)
+    _install_drain_handler(es)
     print(f"[INFO] Event Server is listening at http://{args.ip}:{port}")
     try:
         es.service.serve_forever()
@@ -615,6 +634,7 @@ def cmd_loadtest(args) -> int:
             concurrency=args.concurrency,
             batch_size=args.batch_size,
             channel=args.channel,
+            kill_after_s=args.kill_after,
         )
         print(json.dumps(attach_metrics(result)))
         return 0 if result["errors"] == 0 else 1
@@ -634,6 +654,7 @@ def cmd_loadtest(args) -> int:
         concurrency=args.concurrency,
         samples=samples or None,
         deadline_ms=args.deadline_ms,
+        kill_after_s=args.kill_after,
     )
     print(json.dumps(attach_metrics(result)))
     return 0 if result["errors"] == 0 else 1
@@ -803,6 +824,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--buffer-max", type=int, default=None,
                     help="write-behind capacity; beyond it single-event "
                     "POSTs shed 503 (PIO_INGEST_BUFFER_MAX)")
+    sp.add_argument("--wal-dir", default=None,
+                    help="fast-mode durability: journal fast-acked events "
+                    "to this write-ahead-log directory and replay them on "
+                    "startup (PIO_WAL_DIR; fsync via PIO_WAL_FSYNC)")
     sp.set_defaults(func=cmd_eventserver)
 
     sp = sub.add_parser("storageserver")
@@ -876,6 +901,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="after the run, GET /metrics off the server under test and "
         "include a server-side summary (batch occupancy, fastpath "
         "compiles, breaker states) in the JSON report",
+    )
+    sp.add_argument(
+        "--kill-after", type=float, default=None, metavar="SECONDS",
+        help="POST /stop to the server this many seconds into the run — "
+        "exercises graceful drain under live load; post-stop connection "
+        "failures are reported as afterStop, not errors",
     )
     sp.set_defaults(func=cmd_loadtest)
 

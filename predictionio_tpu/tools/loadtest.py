@@ -77,6 +77,36 @@ def summarize_metrics(series: dict) -> dict:
     return out
 
 
+def _schedule_stop(
+    parsed, conn_cls, kill_after_s: float, stop_state: dict,
+    timeout: float = 5.0,
+) -> threading.Timer:
+    """``--kill-after``: POST /stop at the server mid-run so the load test
+    exercises graceful drain under live traffic. ``stop_state['posted']``
+    flips once the stop landed; workers then classify connection failures
+    as ``afterStop`` instead of errors (an intentionally-stopped server
+    refusing connections is the expected outcome, not a failure)."""
+    host = parsed.hostname
+    port = parsed.port or (443 if parsed.scheme == "https" else 80)
+    path = (parsed.path.rstrip("/") or "") + "/stop"
+
+    def _post_stop():
+        conn = conn_cls(host, port, timeout=timeout)
+        try:
+            conn.request("POST", path, body=b"")
+            conn.getresponse().read()
+            stop_state["posted"] = True
+        except Exception as e:
+            stop_state["error"] = str(e)
+        finally:
+            conn.close()
+
+    timer = threading.Timer(kill_after_s, _post_stop)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def run_loadtest(
     url: str,
     query: dict,
@@ -85,6 +115,7 @@ def run_loadtest(
     timeout: float = 30.0,
     samples: dict = None,
     deadline_ms: float = None,
+    kill_after_s: float = None,
 ) -> dict:
     """``samples`` maps a query FIELD to a list of values; request ``i``
     sends the query with ``field = values[i % len(values)]`` (round-robin,
@@ -99,6 +130,8 @@ def run_loadtest(
     errors: list[str] = []
     shed = [0]  # 503: admission control turned the request away
     deadline_exceeded = [0]  # 504: budget lapsed before/while serving
+    after_stop = [0]  # failures once --kill-after stopped the server
+    stop_state: dict = {"posted": False}
     lock = threading.Lock()
     counter = {"next": 0}
 
@@ -111,6 +144,8 @@ def run_loadtest(
         if parsed.scheme == "https"
         else http.client.HTTPConnection
     )
+    if kill_after_s is not None:
+        _schedule_stop(parsed, conn_cls, kill_after_s, stop_state)
     headers = {"Content-Type": "application/json"}
     if deadline_ms is not None:
         headers["X-Request-Deadline"] = f"{deadline_ms:g}"
@@ -154,7 +189,10 @@ def run_loadtest(
                         latencies.append(time.perf_counter() - t0)
                 except Exception as e:
                     with lock:
-                        errors.append(str(e))
+                        if stop_state["posted"]:
+                            after_stop[0] += 1
+                        else:
+                            errors.append(str(e))
                     conn.close()  # next request reconnects cleanly
         finally:
             conn.close()
@@ -173,7 +211,7 @@ def run_loadtest(
             return float("nan")
         return latencies[min(int(p * len(latencies)), len(latencies) - 1)] * 1e3
 
-    return {
+    out = {
         "requests": requests,
         "concurrency": concurrency,
         "ok": len(latencies),
@@ -186,6 +224,11 @@ def run_loadtest(
         "p90Ms": round(q(0.90), 3),
         "p99Ms": round(q(0.99), 3),
     }
+    if kill_after_s is not None:
+        out["killAfterSec"] = kill_after_s
+        out["stopPosted"] = stop_state["posted"]
+        out["afterStop"] = after_stop[0]
+    return out
 
 
 def run_ingest_loadtest(
@@ -197,6 +240,7 @@ def run_ingest_loadtest(
     timeout: float = 30.0,
     event_template: dict = None,
     channel: str = None,
+    kill_after_s: float = None,
 ) -> dict:
     """Ingest-side load test: POST events at a live Event Server.
 
@@ -222,6 +266,8 @@ def run_ingest_loadtest(
     errors: list[str] = []
     shed = [0]
     acked = [0]
+    after_stop = [0]
+    stop_state: dict = {"posted": False}
     lock = threading.Lock()
     counter = {"next": 0}
 
@@ -239,6 +285,8 @@ def run_ingest_loadtest(
         if parsed.scheme == "https"
         else http.client.HTTPConnection
     )
+    if kill_after_s is not None:
+        _schedule_stop(parsed, conn_cls, kill_after_s, stop_state)
     headers = {"Content-Type": "application/json"}
 
     def payload_for(i: int) -> tuple[bytes, int]:
@@ -283,7 +331,10 @@ def run_ingest_loadtest(
                         acked[0] += ok_items
                 except Exception as e:
                     with lock:
-                        errors.append(str(e))
+                        if stop_state["posted"]:
+                            after_stop[0] += 1
+                        else:
+                            errors.append(str(e))
                     conn.close()
         finally:
             conn.close()
@@ -302,7 +353,7 @@ def run_ingest_loadtest(
             return float("nan")
         return latencies[min(int(p * len(latencies)), len(latencies) - 1)] * 1e3
 
-    return {
+    out = {
         "events": events,
         "batchSize": batch_size,
         "requests": n_requests,
@@ -315,3 +366,8 @@ def run_ingest_loadtest(
         "ackP50Ms": round(q(0.50), 3),
         "ackP99Ms": round(q(0.99), 3),
     }
+    if kill_after_s is not None:
+        out["killAfterSec"] = kill_after_s
+        out["stopPosted"] = stop_state["posted"]
+        out["afterStop"] = after_stop[0]
+    return out

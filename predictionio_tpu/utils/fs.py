@@ -1,10 +1,83 @@
-"""Filesystem roots shared across storage drivers and model persistence."""
+"""Filesystem roots and crash-safe write primitives shared across storage
+drivers, model persistence, and server state files."""
 
 from __future__ import annotations
 
 import os
+import tempfile
 
 
 def pio_base_dir() -> str:
     """The framework's on-disk root (PIO_FS_BASEDIR, parity: conf/pio-env)."""
     return os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a rename into it survives power loss.
+
+    Not every filesystem supports opening a directory for fsync (some
+    network mounts refuse); a refusal downgrades durability, it doesn't
+    break the write, so it is swallowed.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(
+    path: str,
+    data: bytes,
+    fsync: bool = True,
+    crash_site: str = None,
+) -> None:
+    """Crash-safe file publish: write temp → flush → fsync → rename.
+
+    Readers see either the old content or the new content, never a torn
+    mix — ``os.replace`` is atomic on POSIX. The temp file lands in the
+    destination directory (rename must not cross filesystems) with an
+    unpredictable name so concurrent writers can't stomp each other.
+
+    ``crash_site`` names a :mod:`predictionio_tpu.common.faults` crash
+    point evaluated midway through the temp write — with a ``crash`` rule
+    installed the process dies with half a temp file on disk, which is
+    exactly the torn-write state the rename protocol must make invisible.
+    """
+    dirname = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".", suffix=".tmp",
+                               dir=dirname)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            if crash_site is not None and len(data) > 1:
+                from predictionio_tpu.common import faults
+
+                half = len(data) // 2
+                f.write(data[:half])
+                f.flush()
+                faults.crash_point(crash_site)
+                f.write(data[half:])
+            else:
+                f.write(data)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync:
+        fsync_dir(dirname)
+
+
+def atomic_write_text(path: str, text: str, fsync: bool = True) -> None:
+    """:func:`atomic_write` for UTF-8 text payloads."""
+    atomic_write(path, text.encode("utf-8"), fsync=fsync)

@@ -161,7 +161,11 @@ def test_persist_modes_deploy(app_with_events, tmp_path, monkeypatch, mode):
         # MODELDATA holds only a manifest; factors live in the orbax dir
         import pickle
 
-        slots = pickle.loads(storage.get_model_data_models().get(iid).models)
+        from predictionio_tpu.core import persistence
+
+        slots = pickle.loads(persistence.open_model_blob(
+            storage.get_model_data_models().get(iid).models
+        ))
         assert slots[0][0] == "manifest"
         assert (tmp_path / "persistent_models" / iid / "maps.pkl").exists()
     _, algorithms, serving, models = prepare_deploy(

@@ -32,7 +32,7 @@ OUT = os.path.join(REPO, "BENCH_TPU_MANUAL.json")
 # extras (serving latency, solver A/B, measured utilization).
 _PIN = {"BENCH_REBALANCE": "1", "BENCH_DTYPE": "f32"}
 _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
-         "BENCH_INGEST": "0", "BENCH_OBS": "0"}
+         "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -136,6 +136,16 @@ def main() -> int:
         "avg_flush_batch": ingest.get("avg_flush_batch"),
         "flush_errors": ingest.get("flush_errors"),
     }
+    # durability cost from the primary cell: fast-ack throughput under each
+    # WAL fsync policy — `group_vs_off` > 2 means the group-commit fsync is
+    # no longer amortizing and the durability default is taxing ingest
+    durability = primary.get("durability") or {}
+    artifact["durability"] = {
+        "fast_ack_events_per_sec": durability.get("fast_ack_events_per_sec"),
+        "group_vs_off": durability.get("group_vs_off"),
+        "always_vs_off": durability.get("always_vs_off"),
+        "replay_sec_per_10k": durability.get("replay_sec_per_10k"),
+    }
     # telemetry overhead gate from the primary cell: p50 with every request
     # traced vs telemetry compiled out — `gate_pass: false` means the obs
     # subsystem is taxing the hot loop beyond its <3% budget
@@ -156,6 +166,7 @@ def main() -> int:
         **serving,
         "resilience": resilience,
         "ingest": artifact["ingest"],
+        "durability": artifact["durability"],
         "observability": artifact["observability"],
     }))
     return 0 if all_tpu else 1
