@@ -44,6 +44,11 @@ from predictionio_tpu.data.webhooks.connector import (
     get_form_connector,
     get_json_connector,
 )
+# serving-cache invalidation hooks (stdlib-only module, no accelerator
+# deps): every committed write bumps the generations the serving result
+# cache validates against.  In-process only; split-process deployments
+# rely on the cache's TTL backstop (docs/operations.md).
+from predictionio_tpu.serving.result_cache import notify_delete, notify_event
 
 logger = logging.getLogger(__name__)
 
@@ -130,6 +135,7 @@ class EventServer:
                 ),
                 durable_ack=(mode == "durable"),
                 wal=self.wal,
+                on_commit=self._notify_committed,
             )
         self.service = HttpService("eventserver")
         # unified observability (obs/): /metrics + /trace/recent.json, and
@@ -169,6 +175,7 @@ class EventServer:
             for (app_id, channel_id), events in groups.items():
                 le.init(app_id, channel_id)
                 le.insert_batch(events, app_id, channel_id)
+                self._notify_committed(events)
                 replayed += len(events)
         except Exception:
             logger.exception(
@@ -397,6 +404,7 @@ class EventServer:
                 for (i, event), eid in zip(group, ids):
                     self.stats_update(auth, event.event, 201)
                     results[i] = {"eventId": eid, "status": 201}
+                self._notify_committed(events)
                 continue
             for i, event in group:
                 try:
@@ -407,6 +415,7 @@ class EventServer:
                 else:
                     self.stats_update(auth, event.event, 201)
                     results[i] = {"eventId": eid, "status": 201}
+                    self._notify_committed([event])
         return results
 
     def _insert_event(self, auth: dict, event: Event) -> Response:
@@ -419,8 +428,20 @@ class EventServer:
         le = self.storage.get_l_events()
         le.init(auth["app_id"], auth["channel_id"])
         event_id = le.insert(event, auth["app_id"], auth["channel_id"])
+        self._notify_committed([event])
         self.stats_update(auth, event.event, 201)
         return json_response(201, {"eventId": event_id})
+
+    def _notify_committed(self, events: list) -> None:
+        """Committed writes → serving-cache invalidation bumps.  Called at
+        commit time on every write path (direct, batch, buffer flush, WAL
+        replay); never allowed to fail a write that already landed."""
+        try:
+            for event in events:
+                notify_event(event)
+        except Exception:
+            logger.exception("cache-invalidation hook failed; TTL backstop "
+                             "bounds staleness")
 
     def stats_update(self, auth: dict, event_name: str, status: int) -> None:
         if self.stats_enabled:
@@ -534,6 +555,8 @@ class EventServer:
             )
             if not found:
                 return json_response(404, {"message": "Not Found"})
+            # the deleted row's entity is unknown here: invalidate globally
+            notify_delete()
             return json_response(200, {"message": "Found"})
 
         @svc.route("POST", r"/batch/events\.json")

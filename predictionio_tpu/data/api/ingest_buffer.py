@@ -121,9 +121,16 @@ class IngestBuffer:
         retry_policy: Optional[resilience.RetryPolicy] = None,
         name: str = "ingest",
         wal=None,
+        on_commit=None,
     ):
         self._le = le
         self.wal = wal  # WriteAheadLog, journals fast-acked events
+        # called with each flushed batch's events AFTER the storage write
+        # lands (serving-cache invalidation hook).  Commit time, not ack
+        # time: an answer recomputed between a fast ack and its flush reads
+        # pre-flush storage, so only the flush-commit bump can stop it from
+        # re-caching the stale value.
+        self.on_commit = on_commit
         self.flush_interval_s = max(0.0, float(flush_ms)) / 1e3
         self.buffer_max = int(buffer_max)
         self.max_batch = max(1, int(max_batch))
@@ -225,6 +232,13 @@ class IngestBuffer:
                 for _, ticket in items:
                     ticket.resolve(e)
                 continue
+            if self.on_commit is not None:
+                try:
+                    self.on_commit(events)
+                except Exception:
+                    # invalidation must never fail a landed flush; the
+                    # result cache's TTL backstop bounds the damage
+                    pass
             # the storage write landed but the journal still holds the
             # records — the window the kill-9 chaos test aims at (replay
             # re-writes the same ids, so dying here duplicates nothing)

@@ -63,6 +63,12 @@ def bridge_batcher(
                 [("", (), _num(s.get("queries")))],
             ),
             _fam(
+                "pio_batcher_coalesced_total", "counter",
+                "Single-flight followers served by another identical "
+                "query's device slot.",
+                [("", (), _num(s.get("coalesced")))],
+            ),
+            _fam(
                 "pio_batcher_expired_dropped_total", "counter",
                 "Pendings dropped at dispatch because their deadline "
                 "expired while queued.",
@@ -166,7 +172,140 @@ def bridge_fastpath(
                     ],
                 )
             )
+        hot = s.get("hotset")
+        if isinstance(hot, dict):
+            fams.extend([
+                _fam(
+                    "pio_hotset_lookups_total", "counter",
+                    "Fastpath rows answered from the materialized hot-set "
+                    "table (hit) vs the bucketed device path (miss).",
+                    [
+                        ("", (("outcome", "hit"),), _num(hot.get("hits"))),
+                        ("", (("outcome", "miss"),), _num(hot.get("misses"))),
+                    ],
+                ),
+                _fam(
+                    "pio_hotset_refreshes_total", "counter",
+                    "Hot-set re-rank + table materialization passes.",
+                    [("", (), _num(hot.get("refreshes")))],
+                ),
+                _fam(
+                    "pio_hotset_size", "gauge",
+                    "Configured hot-set working-set bound.",
+                    [("", (), _num(hot.get("size")))],
+                ),
+                _fam(
+                    "pio_hotset_resident", "gauge",
+                    "Users currently materialized in the hot-set table.",
+                    [("", (), _num(hot.get("resident")))],
+                ),
+            ])
         return fams
+
+    registry.register_collector(collect)
+
+
+# -- serving: result cache + event cache (one cache idiom, one surface) ------
+
+def bridge_result_cache(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """ResultCache stats → pio_result_cache_* (hits, invalidation split
+    by reason, occupancy)."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        return [
+            _fam(
+                "pio_result_cache_lookups_total", "counter",
+                "Result-cache lookups by outcome.",
+                [
+                    ("", (("outcome", "hit"),), _num(s.get("hits"))),
+                    ("", (("outcome", "miss"),), _num(s.get("misses"))),
+                ],
+            ),
+            _fam(
+                "pio_result_cache_invalidated_total", "counter",
+                "Cached answers dropped at lookup, by reason: event (an "
+                "ingest bump), ttl (backstop lapsed), model (generation "
+                "swapped).",
+                [
+                    ("", (("reason", "event"),),
+                     _num(s.get("invalidated_event"))),
+                    ("", (("reason", "ttl"),),
+                     _num(s.get("invalidated_ttl"))),
+                    ("", (("reason", "model"),),
+                     _num(s.get("invalidated_model"))),
+                ],
+            ),
+            _fam(
+                "pio_result_cache_stores_total", "counter",
+                "Answers written into the result cache.",
+                [("", (), _num(s.get("stores")))],
+            ),
+            _fam(
+                "pio_result_cache_evictions_total", "counter",
+                "LRU evictions under the entry bound.",
+                [("", (), _num(s.get("evictions")))],
+            ),
+            _fam(
+                "pio_result_cache_entries", "gauge",
+                "Entries currently resident.",
+                [("", (), _num(s.get("entries")))],
+            ),
+            _fam(
+                "pio_result_cache_hit_rate", "gauge",
+                "Hits / lookups since start.",
+                [("", (), _num(s.get("hit_rate")))],
+            ),
+        ]
+
+    registry.register_collector(collect)
+
+
+def bridge_event_cache(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """ServingEventCache ``stats_dict()`` → pio_event_cache_* families
+    (the template-level TTL cache for predict-time storage lookups)."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        return [
+            _fam(
+                "pio_event_cache_lookups_total", "counter",
+                "Event-cache lookups by outcome.",
+                [
+                    ("", (("outcome", "hit"),), _num(s.get("hits"))),
+                    ("", (("outcome", "miss"),), _num(s.get("misses"))),
+                ],
+            ),
+            _fam(
+                "pio_event_cache_refreshes_total", "counter",
+                "Background refreshes that replaced a stale value.",
+                [("", (), _num(s.get("refreshes")))],
+            ),
+            _fam(
+                "pio_event_cache_invalidated_total", "counter",
+                "Entries reloaded synchronously after an invalidation-"
+                "token change (event-driven).",
+                [("", (), _num(s.get("invalidated")))],
+            ),
+            _fam(
+                "pio_event_cache_evictions_total", "counter",
+                "Stalest-first evictions under the entry bound.",
+                [("", (), _num(s.get("evictions")))],
+            ),
+            _fam(
+                "pio_event_cache_entries", "gauge",
+                "Entries currently resident.",
+                [("", (), _num(s.get("entries")))],
+            ),
+        ]
 
     registry.register_collector(collect)
 

@@ -28,6 +28,17 @@ The accumulation window is ADAPTIVE, not a fixed sleep:
   (``serving/fastpath.py``): a 9-deep queue dispatches 8 + carries 1
   instead of padding 9→16, so device occupancy stays ≥ 50% by
   construction and the carried tail leads the next batch (FIFO).
+
+SINGLE-FLIGHT COALESCING (opt-in via ``submit(key=...)``): identical
+in-flight queries — same canonical fingerprint — attach to ONE pending
+slot.  The first arrival is the leader and occupies a device row; later
+identical arrivals become followers and never enter the queue at all.
+When the leader's batch delivers, the one result fans out to every
+follower (errors too: a failed batch fails all attached waiters, none
+hang).  Under Zipf traffic a hot key therefore costs one device slot per
+batch regardless of popularity.  If the leader's deadline lapses before
+dispatch, a live follower is PROMOTED to leader so the survivors don't
+inherit a 504 they didn't earn.
 """
 
 from __future__ import annotations
@@ -60,6 +71,10 @@ class _Pending:
     # active scope) + enqueue stamp for the queue_wait stage
     trace: Any = None
     t_enq: float = 0.0
+    # single-flight: the coalescing key this pending leads (None = not
+    # coalescable) and the identical-query followers its result fans out to
+    key: Any = None
+    followers: list = field(default_factory=list)
 
 
 class MicroBatcher:
@@ -93,11 +108,18 @@ class MicroBatcher:
         self._ewma_run = 0.0
         # held for the duration of every batch run (worker or inline)
         self._busy = threading.Lock()
+        # single-flight: key → leader pending currently in flight.  The
+        # lock guards the map AND every leader's followers list; delivery
+        # pops the key first, so a follower can never attach to a pending
+        # whose result already fanned out.
+        self._key_lock = threading.Lock()
+        self._inflight_keys: dict[Any, _Pending] = {}
         # counters (read by stats())
         self._stats_lock = threading.Lock()
         self._n_batches = 0
         self._n_queries = 0
         self._n_inline = 0
+        self._n_coalesced = 0  # followers served by a leader's device slot
         self._n_expired = 0  # pendings dropped un-executed (deadline lapsed)
         self._size_hist: collections.Counter = collections.Counter()
         self._wait_s_total = 0.0
@@ -111,6 +133,7 @@ class MicroBatcher:
         query: Any,
         timeout: float = 30.0,
         deadline: Optional[Deadline] = None,
+        key: Any = None,
     ) -> Any:
         """Enqueue one query; block until its batch runs or the deadline
         passes.
@@ -120,6 +143,11 @@ class MicroBatcher:
         queued is dropped at dispatch (never executed on device — the
         waiter already gave up, running it would burn a device pass on an
         answer nobody reads) and its waiter gets :class:`DeadlineExceeded`.
+
+        ``key`` opts this query into single-flight coalescing: when an
+        identical key is already in flight, this call attaches to the
+        leader's pending and shares its result instead of occupying a
+        device row of its own.
         """
         now = time.perf_counter()
         with self._arr_lock:
@@ -133,7 +161,7 @@ class MicroBatcher:
         active = _tracing.active_traces()
         p = _Pending(
             query, deadline=eff,
-            trace=active[0] if active else None, t_enq=now,
+            trace=active[0] if active else None, t_enq=now, key=key,
         )
         if eff.expired():
             # already over budget at arrival: shed before any queue/device
@@ -141,6 +169,25 @@ class MicroBatcher:
             with self._stats_lock:
                 self._n_expired += 1
             raise DeadlineExceeded("query deadline expired before dispatch")
+        if key is not None:
+            with self._key_lock:
+                leader = self._inflight_keys.get(key)
+                if leader is not None:
+                    # FOLLOWER: ride the leader's device slot; its delivery
+                    # fans the one result (or error) out to us
+                    leader.followers.append(p)
+                else:
+                    self._inflight_keys[key] = p
+            if leader is not None:
+                with self._stats_lock:
+                    self._n_coalesced += 1
+                if not p.event.wait(eff.remaining_s()):
+                    # the leader's batch will still resolve this pending
+                    # (harmlessly, after we've gone) — nothing dangles
+                    raise DeadlineExceeded("coalesced query timed out")
+                if p.error is not None:
+                    raise p.error
+                return p.result
         # TRICKLE BYPASS: nothing queued and no run in flight — execute the
         # singleton inline on this handler thread.  A lone request then pays
         # exactly the direct-path cost (no worker hop, no window), while
@@ -179,9 +226,9 @@ class MicroBatcher:
                 pending.append(self._queue.get_nowait())
             except queue.Empty:
                 break
+        err = RuntimeError("server shutting down")
         for p in pending:
-            p.error = RuntimeError("server shutting down")
-            p.event.set()
+            self._resolve(p, error=err)
 
     def depth(self) -> int:
         """Queued + carried pendings (admission-control signal)."""
@@ -195,6 +242,7 @@ class MicroBatcher:
                 "batches": n_b,
                 "queries": n_q,
                 "inline_batches": self._n_inline,
+                "coalesced": self._n_coalesced,
                 "expired_dropped": self._n_expired,
                 "depth": self.depth(),
                 "avg_batch": round(n_q / n_b, 3) if n_b else None,
@@ -266,6 +314,61 @@ class MicroBatcher:
                 waited = time.perf_counter() - t_first
                 self._execute(batch, waited)
 
+    def _resolve(
+        self,
+        p: _Pending,
+        result: Any = None,
+        error: Optional[BaseException] = None,
+    ) -> None:
+        """Deliver one outcome to a pending AND its coalesced followers.
+
+        The key is detached from the in-flight map FIRST (under the key
+        lock), so no new follower can attach to a pending whose result has
+        already fanned out — late identical arrivals become fresh leaders.
+        A shared error fails every attached waiter; nobody hangs.
+        """
+        followers: list[_Pending] = []
+        if p.key is not None:
+            with self._key_lock:
+                if self._inflight_keys.get(p.key) is p:
+                    del self._inflight_keys[p.key]
+                followers, p.followers = p.followers, []
+        for waiter in [p, *followers]:
+            waiter.result = result
+            waiter.error = error
+            waiter.event.set()
+
+    def _expire_leader(self, p: _Pending) -> Optional[_Pending]:
+        """An expired coalescing leader's followers must not inherit its
+        504: promote the first still-live follower to leader (it takes the
+        batch slot and the remaining followers) and return it; expired
+        followers fail with the leader.  None when nobody survives."""
+        with self._key_lock:
+            owns_key = self._inflight_keys.get(p.key) is p
+            followers, p.followers = p.followers, []
+            promoted = None
+            for i, f in enumerate(followers):
+                if f.deadline is None or not f.deadline.expired():
+                    promoted = f
+                    promoted.followers = followers[i + 1:]
+                    dead = followers[:i]
+                    break
+            else:
+                dead = followers
+            if owns_key:
+                if promoted is not None:
+                    self._inflight_keys[p.key] = promoted
+                else:
+                    del self._inflight_keys[p.key]
+        err = DeadlineExceeded("query deadline expired in queue")
+        for waiter in [p, *dead]:
+            waiter.result = None
+            waiter.error = err
+            waiter.event.set()
+        with self._stats_lock:
+            self._n_expired += 1 + len(dead)
+        return promoted
+
     def _execute(self, batch: list, waited: float, inline: bool = False) -> None:
         """Run one batch and deliver results/errors to every waiter.
 
@@ -280,11 +383,15 @@ class MicroBatcher:
             else:
                 live.append(p)
         for p in expired:
-            p.error = DeadlineExceeded("query deadline expired in queue")
-            p.event.set()
-        if expired:
-            with self._stats_lock:
-                self._n_expired += len(expired)
+            if p.key is not None:
+                promoted = self._expire_leader(p)
+                if promoted is not None:
+                    live.append(promoted)
+            else:
+                p.error = DeadlineExceeded("query deadline expired in queue")
+                p.event.set()
+                with self._stats_lock:
+                    self._n_expired += 1
         batch = live
         if not batch:
             return
@@ -295,6 +402,8 @@ class MicroBatcher:
                 # time between enqueue and dispatch: the coalescing window
                 # the request paid for (≈0 on the inline bypass)
                 p.trace.add_stage("queue_wait", t_run - p.t_enq)
+        results: Optional[list] = None
+        run_error: Optional[BaseException] = None
         try:
             # the worker thread runs ONE batch for many requests: install
             # every member's trace so shared stages (assembly, h2d, device
@@ -306,15 +415,15 @@ class MicroBatcher:
                     f"batch_predict returned {len(results)} results for "
                     f"{len(batch)} queries"
                 )
-            for p, r in zip(batch, results):
-                p.result = r
         except BaseException as e:  # propagate to EVERY waiter
-            for p in batch:
-                p.error = e
+            run_error = e
         run_dt = time.perf_counter() - t_run
         self._ewma_run += self.ALPHA * (run_dt - self._ewma_run)
-        for p in batch:
-            p.event.set()
+        for i, p in enumerate(batch):
+            if run_error is not None:
+                self._resolve(p, error=run_error)
+            else:
+                self._resolve(p, result=results[i])
         with self._stats_lock:
             self._n_batches += 1
             self._n_queries += len(batch)

@@ -41,6 +41,7 @@ class CacheStats:
     misses: int = 0
     refreshes: int = 0
     evictions: int = 0
+    invalidated: int = 0  # entries dropped by an invalidation-token change
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -81,21 +82,41 @@ class ServingEventCache:
         self.stats = CacheStats()
 
     # -- core ---------------------------------------------------------------
-    def get(self, key: Hashable, loader: Callable[[], Any]) -> Any:
+    def get(
+        self,
+        key: Hashable,
+        loader: Callable[[], Any],
+        token: Any = None,
+    ) -> Any:
+        """Cached value for ``key``; loads synchronously on a miss.
+
+        ``token`` opts the entry into event-driven invalidation: pass the
+        current invalidation token for the entities this lookup depends on
+        (``result_cache.INVALIDATIONS.token(...)``).  A stored entry whose
+        token no longer matches is reloaded SYNCHRONOUSLY — the caller
+        sees the post-event value immediately instead of one refresh
+        interval later.  ``token=None`` keeps the pure TTL behavior.
+        """
         now = self._clock()
         with self._lock:
             entry = self._data.get(key)
+            if entry is not None and token is not None and entry[2] != token:
+                # an event moved a dependency: the stale value must not be
+                # served even once, so this is a hard miss, not a refresh
+                del self._data[key]
+                self.stats.invalidated += 1
+                entry = None
             if entry is not None:
                 self.stats.hits += 1
         if entry is not None:
-            value, loaded_at = entry
+            value, loaded_at, _ = entry
             if now - loaded_at >= self.refresh_interval:
-                self._schedule_refresh(key, loader)
+                self._schedule_refresh(key, loader, token)
             return value
         value = loader()
         with self._lock:
             self.stats.misses += 1
-            self._data[key] = (value, now)
+            self._data[key] = (value, now, token)
             self._data.move_to_end(key)
             self._evict_locked()
         return value
@@ -112,6 +133,18 @@ class ServingEventCache:
         with self._lock:
             return len(self._data)
 
+    def stats_dict(self) -> dict:
+        """Counter snapshot + sizing for the obs bridge
+        (``pio_event_cache_*``).  Named ``stats_dict`` because ``stats``
+        is the live :class:`CacheStats` attribute."""
+        with self._lock:
+            out = self.stats.to_dict()
+            out["entries"] = len(self._data)
+            out["max_entries"] = self.max_entries
+            out["refresh_interval_s"] = self.refresh_interval
+            out["inflight_refreshes"] = len(self._inflight)
+        return out
+
     # -- internals ----------------------------------------------------------
     def _evict_locked(self) -> None:
         # stalest-first (insertion/refresh order) O(1) eviction; max_entries
@@ -120,7 +153,9 @@ class ServingEventCache:
             self._data.popitem(last=False)
             self.stats.evictions += 1
 
-    def _schedule_refresh(self, key: Hashable, loader: Callable[[], Any]) -> None:
+    def _schedule_refresh(
+        self, key: Hashable, loader: Callable[[], Any], token: Any = None
+    ) -> None:
         # same clock as entry ages: with an injected test clock the staleness
         # and hung-refresh timeout domains must not diverge
         started = self._clock()
@@ -148,7 +183,7 @@ class ServingEventCache:
                     # a superseded (hung-then-completed) refresh must not
                     # clobber a newer one's in-flight bookkeeping
                     if self._inflight.get(key) == started:
-                        self._data[key] = (value, self._clock())
+                        self._data[key] = (value, self._clock(), token)
                         self._data.move_to_end(key)
                         self.stats.refreshes += 1
             except Exception:

@@ -53,6 +53,12 @@ from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.obs import bridges as _bridges
 from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.serving.result_cache import (
+    canonical_fingerprint,
+    coalesce_from_env,
+    entity_ids_from,
+    result_cache_from_env,
+)
 from predictionio_tpu.utils.profiling import LatencyHistogram
 
 logger = logging.getLogger(__name__)
@@ -138,6 +144,8 @@ class QueryServer:
         default_deadline_ms: Optional[float] = None,
         warm_fastpath: Optional[bool] = None,
         telemetry: bool = True,
+        result_cache=None,
+        coalesce: Optional[bool] = None,
     ):
         self.engine = engine
         self.storage = storage or Storage.instance()
@@ -212,6 +220,22 @@ class QueryServer:
         self._warm_fastpath = (
             batching if warm_fastpath is None else bool(warm_fastpath)
         )
+        # skew hot path (ISSUE 6): result cache for identical queries +
+        # single-flight coalescing at the batcher.  Both default from env
+        # knobs (PIO_RESULT_CACHE / PIO_COALESCE, off-by-default-safe);
+        # pass result_cache=ResultCache(...) or coalesce=True to force.
+        # Must exist before the first reload(): a reload bumps the serving
+        # generation and flushes the cache.
+        self._result_cache = (
+            result_cache_from_env() if result_cache is None else result_cache
+        )
+        self._coalesce = (
+            coalesce_from_env() if coalesce is None else bool(coalesce)
+        )
+        # model-generation tag: every successful swap increments it, so
+        # cached answers from the previous generation can never validate
+        # even if clear() were to race a concurrent put
+        self._serving_gen = 0
         self._register_routes()
         self.reload()
         self._batcher = None
@@ -291,10 +315,19 @@ class QueryServer:
         )
         with self._lock:
             self._deployed = deployed
+        self._note_generation_swap()
         self._reload_degraded = False
         self._record_last_known_good(instance.id)
         logger.info("deployed engine instance %s", instance.id)
         return instance.id
+
+    def _note_generation_swap(self) -> None:
+        """A new model generation is live: bump the serving generation (the
+        result cache's model tag) and flush — answers computed against the
+        previous generation must never be served against this one."""
+        self._serving_gen += 1
+        if self._result_cache is not None:
+            self._result_cache.clear()
 
     # -- last-known-good pointer (survives restarts) -------------------------
     def _lkg_path(self) -> str:
@@ -365,6 +398,7 @@ class QueryServer:
             )
             with self._lock:
                 self._deployed = deployed
+            self._note_generation_swap()
             self.counters.inc("reload_failed")
             self._reload_degraded = True
             self._record_last_known_good(iid)
@@ -389,6 +423,19 @@ class QueryServer:
             s = get_stats(model)
             if s is not None:
                 return s
+        return None
+
+    def _event_cache_stats(self) -> Optional[dict]:
+        """First deployed algorithm's ServingEventCache stats, if any (the
+        e-commerce template creates one lazily on its first predict)."""
+        with self._lock:
+            d = self._deployed
+        if d is None:
+            return None
+        for algo in d.algorithms:
+            cache = getattr(algo, "_event_cache", None)
+            if cache is not None:
+                return cache.stats_dict()
         return None
 
     def _register_metrics(self) -> None:
@@ -419,6 +466,19 @@ class QueryServer:
         if self._batcher is not None:
             _bridges.bridge_batcher(reg, self._batcher.stats)
         _bridges.bridge_fastpath(reg, self._fastpath_stats)
+        if self._result_cache is not None:
+            _bridges.bridge_result_cache(reg, self._result_cache.stats)
+        reg.gauge_fn(
+            "pio_result_cache_enabled",
+            "1 when the serving result cache is active.",
+            lambda: 0.0 if self._result_cache is None else 1.0,
+        )
+        reg.gauge_fn(
+            "pio_coalesce_enabled",
+            "1 when single-flight coalescing of identical queries is on.",
+            lambda: 1.0 if self._coalesce else 0.0,
+        )
+        _bridges.bridge_event_cache(reg, self._event_cache_stats)
         _bridges.bridge_resilience(
             reg,
             lambda: {"breakers": [self._feedback_breaker.stats()]},
@@ -516,53 +576,91 @@ class QueryServer:
         with _tracing.stage("decode"):
             query = bind_query(self.engine.query_cls, data)
         degraded = False
-        try:
-            if deadline is not None and deadline.expired():
-                raise DeadlineExceeded("deadline expired before predict")
-            if self._batcher is not None:
-                supplemented, prediction = self._batcher.submit(
-                    query, deadline=deadline
-                )
-            else:
-                supplemented = deployed.serving.supplement(query)
-                predictions = [
-                    algo.predict(model, supplemented)
-                    for algo, model in zip(deployed.algorithms, deployed.models)
-                ]
-                prediction = deployed.serving.serve(supplemented, predictions)
-            with _tracing.stage("serialize"):
-                result = _to_jsonable(prediction)
-        except DeadlineExceeded:
-            self.counters.inc("deadline_exceeded")
-            raise
-        except TypeError:
-            # malformed query values are a CLIENT bug: surface them through
-            # the route's TypeError → 400 mapping, never mask them behind a
-            # stale degraded 200 (which would also pollute the `degraded`
-            # counter bench.py's clean gate reads as a server regression)
-            self.counters.inc("query_errors")
-            raise
-        except Exception as e:
-            # scorer/model failure: serve the degraded fallback rather than
-            # a 500 — availability beats freshness for a serving surface
-            fallback = self._fallback_result(query, deployed)
-            if fallback is None:
+        cache = self._result_cache
+        # one canonical fingerprint serves both layers: the result-cache
+        # key here and the single-flight coalescing key at the batcher
+        fp = (
+            canonical_fingerprint(data)
+            if (cache is not None or self._coalesce)
+            else None
+        )
+        cache_hit = False
+        if cache is not None and fp is not None:
+            cached = cache.get(fp, self._serving_gen)
+            if cached is not None:
+                cache_hit = True
+                result = cached
+                # no supplemented form exists on a hit; plugins and
+                # feedback see the bound query, as on the degraded path
+                supplemented = query
+        if not cache_hit:
+            try:
+                if deadline is not None and deadline.expired():
+                    raise DeadlineExceeded("deadline expired before predict")
+                if self._batcher is not None:
+                    supplemented, prediction = self._batcher.submit(
+                        query, deadline=deadline,
+                        key=fp if self._coalesce else None,
+                    )
+                else:
+                    supplemented = deployed.serving.supplement(query)
+                    predictions = [
+                        algo.predict(model, supplemented)
+                        for algo, model in zip(
+                            deployed.algorithms, deployed.models
+                        )
+                    ]
+                    prediction = deployed.serving.serve(
+                        supplemented, predictions
+                    )
+                with _tracing.stage("serialize"):
+                    result = _to_jsonable(prediction)
+            except DeadlineExceeded:
+                self.counters.inc("deadline_exceeded")
+                raise
+            except TypeError:
+                # malformed query values are a CLIENT bug: surface them
+                # through the route's TypeError → 400 mapping, never mask
+                # them behind a stale degraded 200 (which would also pollute
+                # the `degraded` counter bench.py's clean gate reads as a
+                # server regression)
                 self.counters.inc("query_errors")
                 raise
-            self.counters.inc("degraded")
-            self._rl_log.warning(
-                "degraded", "prediction failed (%s); serving degraded "
-                "fallback", e,
-            )
-            result = fallback
-            result["degraded"] = True
-            supplemented = query
-            degraded = True
+            except Exception as e:
+                # scorer/model failure: serve the degraded fallback rather
+                # than a 500 — availability beats freshness for serving
+                fallback = self._fallback_result(query, deployed)
+                if fallback is None:
+                    self.counters.inc("query_errors")
+                    raise
+                self.counters.inc("degraded")
+                self._rl_log.warning(
+                    "degraded", "prediction failed (%s); serving degraded "
+                    "fallback", e,
+                )
+                result = fallback
+                result["degraded"] = True
+                supplemented = query
+                degraded = True
         if not degraded:
             # remember the newest good answer for the degraded path; shallow
             # copy so prId/plugin rewrites never leak back into the cache
             if isinstance(result, dict):
                 self._last_good = dict(result)
+            if (
+                cache is not None
+                and fp is not None
+                and not cache_hit
+                and isinstance(result, dict)
+            ):
+                # store the pre-plugin, pre-prId answer: plugins rewrite
+                # per caller and run on every hit; degraded answers are
+                # never cached (they would outlive the failure)
+                cache.put(
+                    fp, result,
+                    entity_ids_from(data, cache.key_fields),
+                    self._serving_gen,
+                )
         # plugins see JSON values, as in the reference (JValue-based process)
         for p in self.plugins:
             if p.plugin_type == EngineServerPlugin.OUTPUT_BLOCKER:
@@ -681,6 +779,12 @@ class QueryServer:
             info["batching"] = (
                 self._batcher.stats() if self._batcher is not None else None
             )
+            info["resultCache"] = (
+                self._result_cache.stats()
+                if self._result_cache is not None
+                else None
+            )
+            info["coalesce"] = self._coalesce
             fp = []
             for algo, model in zip(algorithms, models):
                 get_stats = getattr(algo, "serving_stats", None)

@@ -216,7 +216,16 @@ class ECommAlgorithm(Algorithm):
 
     def _seen_items(self, user: str) -> set:
         if self.params.cacheRefreshSeconds > 0:
-            return self._cache.get(("seen", user), lambda: self._load_seen(user))
+            from predictionio_tpu.serving.result_cache import INVALIDATIONS
+
+            # event-driven invalidation: a new event for this user bumps
+            # their generation, so the seen-set reloads synchronously on
+            # the next query instead of one refresh interval later
+            return self._cache.get(
+                ("seen", user),
+                lambda: self._load_seen(user),
+                token=INVALIDATIONS.token((user,)),
+            )
         return self._load_seen(user)
 
     def _load_seen(self, user: str) -> set:
@@ -236,8 +245,14 @@ class ECommAlgorithm(Algorithm):
 
     def _unavailable_items(self) -> set:
         if self.params.cacheRefreshSeconds > 0:
+            from predictionio_tpu.serving.result_cache import INVALIDATIONS
+
+            # the constraint entity is written via $set, which bumps the
+            # GLOBAL generation — captured here through the token
             return self._cache.get(
-                ("constraint", "unavailableItems"), self._load_unavailable
+                ("constraint", "unavailableItems"),
+                self._load_unavailable,
+                token=INVALIDATIONS.token(("unavailableItems",)),
             )
         return self._load_unavailable()
 

@@ -655,6 +655,9 @@ def cmd_loadtest(args) -> int:
         samples=samples or None,
         deadline_ms=args.deadline_ms,
         kill_after_s=args.kill_after,
+        dist=args.dist,
+        zipf_s=args.zipf_s,
+        zipf_q=args.zipf_q,
     )
     print(json.dumps(attach_metrics(result)))
     return 0 if result["errors"] == 0 else 1
@@ -876,6 +879,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--sample", action="append", metavar="FIELD=V1,V2,...",
         help="rotate FIELD through the listed values round-robin, one per "
         "request (mixed-key tail latency instead of one hot payload)",
+    )
+    sp.add_argument(
+        "--dist", choices=("roundrobin", "zipf"), default="roundrobin",
+        help="how --sample values are drawn: roundrobin cycles them "
+        "evenly; zipf draws Zipf-Mandelbrot skew (early values hottest — "
+        "real traffic's shape, what the serving caches exploit) and adds "
+        "per-key latency percentiles to the report",
+    )
+    sp.add_argument(
+        "--zipf-s", type=float, default=1.1,
+        help="Zipf-Mandelbrot exponent for --dist zipf (higher = hotter "
+        "head)",
+    )
+    sp.add_argument(
+        "--zipf-q", type=float, default=50.0,
+        help="Zipf-Mandelbrot shift for --dist zipf (higher = flatter "
+        "head, like real catalogs)",
     )
     sp.add_argument(
         "--deadline-ms", type=float, default=None,

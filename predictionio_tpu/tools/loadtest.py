@@ -22,6 +22,23 @@ import time
 import urllib.parse
 
 
+def zipf_mandelbrot_weights(n: int, s: float = 1.1, q: float = 50.0):
+    """Zipf-Mandelbrot pmf ``P(k) ∝ (k+q)^-s`` over ranks ``[0, n)``.
+
+    The q shift matches real catalogs: at s=1.1, q=50 the hottest of ~59k
+    ids draws ~0.4% of traffic, like ML-25M's ~0.32% — a pure Zipf head
+    would take ~10%, which no real workload does.  Shared with bench.py's
+    ``_sample_ids`` so the load test and the training bench agree on what
+    "skewed" means.  Returns a normalized float64 numpy array (numpy is
+    imported lazily: round-robin load tests stay stdlib-only).
+    """
+    import numpy as np
+
+    ranks = np.arange(1, n + 1, dtype=np.float64)
+    p = (ranks + q) ** -s
+    return p / p.sum()
+
+
 def scrape_metrics(url: str, timeout: float = 10.0) -> dict:
     """Scrape ``GET /metrics`` off the server under test and return the
     parsed series as ``{(name, ((label, value), ...)): value}``.
@@ -59,8 +76,13 @@ def summarize_metrics(series: dict) -> dict:
     """Condense a :func:`scrape_metrics` result to the handful of series a
     loadtest report cares about (JSON-friendly, stable keys)."""
 
-    def total(name: str) -> float:
-        return sum(v for (n, _), v in series.items() if n == name)
+    def total(name: str, **want: str) -> float:
+        return sum(
+            v
+            for (n, labels), v in series.items()
+            if n == name
+            and all(dict(labels).get(k) == val for k, val in want.items())
+        )
 
     out = {
         "seriesCount": len(series),
@@ -69,6 +91,21 @@ def summarize_metrics(series: dict) -> dict:
         "batcherQueries": total("pio_batcher_queries_total"),
         "eventsIngested": total("pio_events_ingested_total"),
     }
+    # skew-path families only exist when the serving caches are on — a
+    # zipf loadtest without these keys means the server isn't configured
+    # to absorb the hot head
+    if total("pio_result_cache_enabled"):
+        out["resultCacheHits"] = total(
+            "pio_result_cache_lookups_total", outcome="hit"
+        )
+        out["resultCacheMisses"] = total(
+            "pio_result_cache_lookups_total", outcome="miss"
+        )
+    if ("pio_batcher_coalesced_total", ()) in series:
+        out["coalesced"] = total("pio_batcher_coalesced_total")
+    if total("pio_hotset_size"):
+        out["hotsetHits"] = total("pio_hotset_lookups_total", outcome="hit")
+        out["hotsetResident"] = total("pio_hotset_resident")
     for (name, labels), v in sorted(series.items()):
         if name.endswith("_breaker_state"):
             out.setdefault("breakerStates", {})[
@@ -107,6 +144,35 @@ def _schedule_stop(
     return timer
 
 
+def _per_key_summary(key_lats: dict, top_n: int = 8) -> dict:
+    """Per-key latency percentiles: the ``top_n`` most-requested keys
+    individually, the rest folded into one ``coldTail`` aggregate.  Under
+    skew this is the interesting split — hot keys should ride the cache
+    (p50 well under the cold tail's) and the cold tail should not be
+    starved by them."""
+
+    def pct(lats: list, p: float) -> float:
+        return round(lats[min(int(p * len(lats)), len(lats) - 1)] * 1e3, 3)
+
+    ranked = sorted(key_lats.items(), key=lambda kv: -len(kv[1]))
+    hot, cold = ranked[:top_n], ranked[top_n:]
+    out = {
+        "distinctKeys": len(key_lats),
+        "hotKeys": [
+            {"key": k, "n": len(v), "p50Ms": pct(sorted(v), 0.50),
+             "p99Ms": pct(sorted(v), 0.99)}
+            for k, v in hot
+        ],
+    }
+    cold_all = sorted(dt for _, v in cold for dt in v)
+    if cold_all:
+        out["coldTail"] = {
+            "keys": len(cold), "n": len(cold_all),
+            "p50Ms": pct(cold_all, 0.50), "p99Ms": pct(cold_all, 0.99),
+        }
+    return out
+
+
 def run_loadtest(
     url: str,
     query: dict,
@@ -116,6 +182,10 @@ def run_loadtest(
     samples: dict = None,
     deadline_ms: float = None,
     kill_after_s: float = None,
+    dist: str = "roundrobin",
+    zipf_s: float = 1.1,
+    zipf_q: float = 50.0,
+    seed: int = 0,
 ) -> dict:
     """``samples`` maps a query FIELD to a list of values; request ``i``
     sends the query with ``field = values[i % len(values)]`` (round-robin,
@@ -123,10 +193,35 @@ def run_loadtest(
     hot cache line — p50 flatters; mixed keys are what tail latency
     means. Without ``samples`` the single payload is sent verbatim.
 
+    ``dist="zipf"`` replaces the round-robin rotation with Zipf-Mandelbrot
+    draws (``P(k) ∝ (k+q)^-s``, early sample values hottest) — the shape
+    real traffic has, and the one the serving hot path (result cache,
+    single-flight, hot-set) is built to exploit.  Draws are seeded, so a
+    run is reproducible.  With ``samples`` set, the summary also carries
+    ``perKey``: per-key latency percentiles for the hottest keys plus a
+    cold-tail aggregate, which is where a skew win (hot keys far below
+    the cold p50) or a skew bug (hot keys starving the tail) shows up.
+
     ``deadline_ms`` attaches an ``X-Request-Deadline`` budget to every
     request; the server sheds (503) or deadline-504s what it can't serve
     in time, and both are broken out of ``errors`` in the result."""
+    if dist not in ("roundrobin", "zipf"):
+        raise ValueError(f"dist must be roundrobin|zipf, got {dist!r}")
+    # request i's value index per sample field (zipf pre-draws the whole
+    # schedule up front so worker interleaving can't change the workload)
+    sample_idx: dict = {}
+    if dist == "zipf" and samples:
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        for field, values in samples.items():
+            weights = zipf_mandelbrot_weights(len(values), zipf_s, zipf_q)
+            sample_idx[field] = rng.choice(
+                len(values), size=requests, p=weights
+            ).tolist()
+
     latencies: list[float] = []
+    key_lats: dict = {}  # sampled-field values → successful latencies
     errors: list[str] = []
     shed = [0]  # 503: admission control turned the request away
     deadline_exceeded = [0]  # 504: budget lapsed before/while serving
@@ -152,13 +247,16 @@ def run_loadtest(
 
     fixed_payload = json.dumps(query).encode()
 
-    def payload_for(i: int) -> bytes:
+    def payload_for(i: int) -> tuple:
         if not samples:
-            return fixed_payload
+            return fixed_payload, None
         q = dict(query)
+        picked = []
         for field, values in samples.items():
-            q[field] = values[i % len(values)]
-        return json.dumps(q).encode()
+            idx = sample_idx[field][i] if field in sample_idx else i % len(values)
+            q[field] = values[idx]
+            picked.append(str(values[idx]))
+        return json.dumps(q).encode(), "|".join(picked)
 
     def worker():
         conn = conn_cls(host, port, timeout=timeout)
@@ -169,7 +267,7 @@ def run_loadtest(
                         return
                     i = counter["next"]
                     counter["next"] += 1
-                body = payload_for(i)
+                body, key = payload_for(i)
                 t0 = time.perf_counter()
                 try:
                     conn.request("POST", path, body=body, headers=headers)
@@ -185,8 +283,11 @@ def run_loadtest(
                         continue
                     if resp.status >= 400:
                         raise RuntimeError(f"HTTP {resp.status}")
+                    dt = time.perf_counter() - t0
                     with lock:
-                        latencies.append(time.perf_counter() - t0)
+                        latencies.append(dt)
+                        if key is not None:
+                            key_lats.setdefault(key, []).append(dt)
                 except Exception as e:
                     with lock:
                         if stop_state["posted"]:
@@ -214,6 +315,7 @@ def run_loadtest(
     out = {
         "requests": requests,
         "concurrency": concurrency,
+        "dist": dist,
         "ok": len(latencies),
         "errors": len(errors),
         "shed": shed[0],
@@ -224,6 +326,8 @@ def run_loadtest(
         "p90Ms": round(q(0.90), 3),
         "p99Ms": round(q(0.99), 3),
     }
+    if key_lats:
+        out["perKey"] = _per_key_summary(key_lats)
     if kill_after_s is not None:
         out["killAfterSec"] = kill_after_s
         out["stopPosted"] = stop_state["posted"]

@@ -138,3 +138,90 @@ def test_no_adhoc_module_level_counters():
         for issue in module_level_counters(path)
     ]
     assert not issues, "\n".join(issues)
+
+
+# -- cache hygiene: one cache idiom, one invalidation story -------------------
+
+# Caching that predates the serving cache layer, grandfathered as
+# "path:name". These are jit-compilation caches keyed by static config —
+# they hold compiled XLA programs, not data, so event-driven invalidation
+# doesn't apply to them. Everything NEW found by this lint is a
+# regression: a per-module cache outside serving/ has no invalidation
+# hook (events can't reach it), no obs bridge (/metrics can't see it),
+# and no TTL backstop — serving/result_cache.py and
+# serving/event_cache.py exist so stale-answer bugs have one home.
+CACHE_ALLOWLIST = {
+    "predictionio_tpu/parallel/ring.py:_build_ring_fn",
+    "predictionio_tpu/parallel/ring.py:_build_ring_flash_fn",
+    "predictionio_tpu/parallel/ulysses.py:_build_ulysses_fn",
+    # per-response Date header memo, rebuilt every second; not a data cache
+    "predictionio_tpu/common/http.py:_DATE_CACHE",
+}
+
+_CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
+
+
+def _decorator_name(dec: ast.expr) -> str:
+    # @lru_cache, @functools.lru_cache, @lru_cache(maxsize=N) all resolve
+    # to the bare callee name
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Attribute):
+        return dec.attr
+    return getattr(dec, "id", "")
+
+
+def adhoc_caches(path: str) -> list[str]:
+    """Module-level caching outside the serving cache layer: memoizing
+    decorators (``functools.lru_cache``/``cache``) and module-level
+    globals whose name says cache (``X_CACHE = {...}``, ``_cache = {}``).
+    Instance attributes are out of scope — they die with their owner."""
+    tree = ast.parse(open(path).read())
+    rel = os.path.relpath(path, os.path.dirname(PKG))
+    issues = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                name = _decorator_name(dec)
+                if name in _CACHE_DECORATORS and name != "cached_property":
+                    key = f"{rel}:{node.name}"
+                    if key not in CACHE_ALLOWLIST:
+                        issues.append(
+                            f"{path}:{node.lineno}: @{name} on "
+                            f"{node.name!r} — per-module caches belong in "
+                            "predictionio_tpu/serving (result_cache/"
+                            "event_cache: invalidation + obs + TTL), not "
+                            "in ad-hoc memoizers"
+                        )
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if not t.id.lower().rstrip("s").endswith("cache"):
+                continue
+            key = f"{rel}:{t.id}"
+            if key not in CACHE_ALLOWLIST:
+                issues.append(
+                    f"{path}:{node.lineno}: module-level cache global "
+                    f"{t.id!r} — use serving/result_cache.py or "
+                    "serving/event_cache.py (they carry invalidation, "
+                    "obs bridging, and a TTL backstop)"
+                )
+    return issues
+
+
+def test_no_adhoc_caches_outside_serving():
+    serving_dir = os.path.join(PKG, "serving")
+    issues = [
+        issue
+        for path in iter_modules()
+        if not path.startswith(serving_dir)
+        for issue in adhoc_caches(path)
+    ]
+    assert not issues, "\n".join(issues)

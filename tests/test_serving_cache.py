@@ -1,0 +1,752 @@
+"""Consistency contract of the skew-exploiting serving path.
+
+The three layers added for the Zipf gap — result cache with event-driven
+invalidation (serving/result_cache.py), single-flight coalescing at the
+micro-batcher, and the hot-set fastpath — all trade repeated device work
+for memory.  What they must NEVER trade away:
+
+* coalesced waiters all receive the one result; a failed batch fails
+  every attached waiter (nobody hangs);
+* a cached answer dies the moment a relevant event COMMITS — including
+  through the write-behind buffer and WAL;
+* a model reload / cold-start fallback flushes every cached answer;
+* chaos (PIO_FAULT_SPEC) degrades availability, never correctness.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.common import faults
+from predictionio_tpu.common.resilience import Deadline, DeadlineExceeded
+from predictionio_tpu.serving.batching import MicroBatcher
+from predictionio_tpu.serving.result_cache import (
+    DEFAULT_KEY_FIELDS,
+    InvalidationIndex,
+    ResultCache,
+    canonical_fingerprint,
+    entity_ids_from,
+    notify_delete,
+    notify_event,
+    result_cache_from_env,
+)
+
+
+def call(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+# -- fingerprint --------------------------------------------------------------
+
+
+class TestFingerprint:
+    def test_field_order_is_canonical(self):
+        a = canonical_fingerprint({"user": "u1", "num": 3})
+        b = canonical_fingerprint({"num": 3, "user": "u1"})
+        assert a == b and a is not None
+
+    def test_prid_never_splits_the_key(self):
+        # the feedback tag changes per request but not the prediction
+        a = canonical_fingerprint({"user": "u1", "prId": "x"})
+        b = canonical_fingerprint({"user": "u1", "prId": "y"})
+        c = canonical_fingerprint({"user": "u1"})
+        assert a == b == c
+
+    def test_different_values_differ(self):
+        assert canonical_fingerprint({"user": "u1"}) != canonical_fingerprint(
+            {"user": "u2"}
+        )
+
+    def test_unfingerprintable_is_none(self):
+        assert canonical_fingerprint({"x": object()}) is None
+        assert canonical_fingerprint("not a dict") is None
+
+    def test_entity_ids_scalars_and_lists(self):
+        data = {"user": "u1", "items": ["i1", 2], "num": 5, "junk": {"a": 1}}
+        assert entity_ids_from(data, DEFAULT_KEY_FIELDS) == ("u1", "i1", "2")
+        assert entity_ids_from({}, DEFAULT_KEY_FIELDS) == ()
+
+
+# -- invalidation index -------------------------------------------------------
+
+
+class TestInvalidationIndex:
+    def test_bump_moves_only_that_entity(self):
+        idx = InvalidationIndex()
+        t_u1 = idx.token(("u1",))
+        t_u2 = idx.token(("u2",))
+        idx.bump_entities(("u1",))
+        assert idx.token(("u1",)) != t_u1
+        assert idx.token(("u2",)) == t_u2
+
+    def test_bump_all_moves_every_token(self):
+        idx = InvalidationIndex()
+        t = idx.token(("anything",))
+        idx.bump_all()
+        assert idx.token(("anything",)) != t
+
+    def test_eviction_bumps_global_never_stales(self):
+        # the overflow contract: dropping an entity's counter must degrade
+        # to COARSER invalidation, not let a stale token validate
+        idx = InvalidationIndex(max_entities=2)
+        idx.bump_entities(("a",))
+        stale = idx.token(("a",))
+        idx.bump_entities(("b", "c"))  # evicts "a", global gen bumps
+        assert idx.token(("a",)) != stale
+        assert idx.stats()["evictions"] >= 1
+
+    def test_notify_event_routes_entities(self):
+        class Ev:
+            event = "view"
+            entity_id = "nu1"
+            target_entity_id = "ni1"
+
+        idx = InvalidationIndex()
+        from predictionio_tpu.serving import result_cache as rc
+
+        old, rc.INVALIDATIONS = rc.INVALIDATIONS, idx
+        try:
+            t = idx.token(("nu1", "ni1"))
+            notify_event(Ev())
+            assert idx.token(("nu1", "ni1")) != t
+            # $-events reach entities no query field names → global
+            Ev.event = "$set"
+            t_other = idx.token(("unrelated",))
+            notify_event(Ev())
+            assert idx.token(("unrelated",)) != t_other
+            t_other = idx.token(("unrelated",))
+            notify_delete()
+            assert idx.token(("unrelated",)) != t_other
+        finally:
+            rc.INVALIDATIONS = old
+
+
+# -- result cache -------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestResultCache:
+    def make(self, **kw):
+        clk = _Clock()
+        idx = InvalidationIndex()
+        kw.setdefault("max_entries", 4)
+        kw.setdefault("ttl_s", 10.0)
+        cache = ResultCache(index=idx, clock=clk, **kw)
+        return cache, idx, clk
+
+    def test_hit_miss_and_stats(self):
+        cache, idx, clk = self.make()
+        assert cache.get("fp", 0) is None  # miss
+        cache.put("fp", {"a": 1}, ("u1",), 0)
+        assert cache.get("fp", 0) == {"a": 1}
+        s = cache.stats()
+        assert s["hits"] == 1 and s["misses"] == 1 and s["stores"] == 1
+        assert s["hit_rate"] == 0.5
+
+    def test_ttl_backstop(self):
+        cache, idx, clk = self.make(ttl_s=5.0)
+        cache.put("fp", {"a": 1}, (), 0)
+        clk.t += 4.9
+        assert cache.get("fp", 0) is not None
+        clk.t += 0.2
+        assert cache.get("fp", 0) is None
+        assert cache.stats()["invalidated_ttl"] == 1
+
+    def test_event_invalidation(self):
+        cache, idx, clk = self.make()
+        cache.put("fp", {"a": 1}, ("u1",), 0)
+        idx.bump_entities(("u9",))  # unrelated entity: still valid
+        assert cache.get("fp", 0) is not None
+        idx.bump_entities(("u1",))
+        assert cache.get("fp", 0) is None
+        assert cache.stats()["invalidated_event"] == 1
+
+    def test_model_generation_flush(self):
+        cache, idx, clk = self.make()
+        cache.put("fp", {"a": 1}, ("u1",), model_gen=3)
+        assert cache.get("fp", 4) is None  # reload happened
+        assert cache.stats()["invalidated_model"] == 1
+
+    def test_lru_eviction_bound(self):
+        cache, idx, clk = self.make(max_entries=2)
+        for i in range(3):
+            cache.put(f"fp{i}", {"i": i}, (), 0)
+        assert len(cache) == 2
+        assert cache.get("fp0", 0) is None  # oldest evicted
+        assert cache.get("fp2", 0) is not None
+        assert cache.stats()["evictions"] == 1
+
+    def test_values_are_isolated_copies(self):
+        cache, idx, clk = self.make()
+        original = {"itemScores": [{"item": "i1"}]}
+        cache.put("fp", original, (), 0)
+        original["itemScores"].append({"item": "mutated-after-put"})
+        got = cache.get("fp", 0)
+        assert got == {"itemScores": [{"item": "i1"}]}
+        got["prId"] = "caller-mutation"  # e.g. feedback tagging
+        assert cache.get("fp", 0) == {"itemScores": [{"item": "i1"}]}
+
+    def test_env_construction(self, monkeypatch):
+        monkeypatch.delenv("PIO_RESULT_CACHE", raising=False)
+        assert result_cache_from_env() is None  # off-by-default-safe
+        monkeypatch.setenv("PIO_RESULT_CACHE", "1")
+        monkeypatch.setenv("PIO_RESULT_CACHE_TTL_MS", "1500")
+        monkeypatch.setenv("PIO_RESULT_CACHE_MAX", "7")
+        monkeypatch.setenv("PIO_RESULT_CACHE_KEYS", "user, uid")
+        cache = result_cache_from_env()
+        assert cache.ttl_s == 1.5 and cache.max_entries == 7
+        assert cache.key_fields == ("user", "uid")
+
+
+# -- single-flight coalescing at the micro-batcher ----------------------------
+
+
+def _wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.002)
+    return False
+
+
+class TestSingleFlight:
+    def test_followers_share_one_device_slot(self):
+        gate = threading.Event()
+        calls = []
+
+        def run_batch(batch):
+            calls.append(list(batch))
+            gate.wait(5)
+            return [f"r:{q}" for q in batch]
+
+        mb = MicroBatcher(run_batch)
+        results = {}
+
+        def submit(i):
+            results[i] = mb.submit("q", key="k")
+
+        leader = threading.Thread(target=submit, args=(0,))
+        leader.start()
+        # leader is inline-executing (blocked in run_batch) before
+        # followers arrive, so every follower attaches to its pending
+        assert _wait_for(lambda: calls and "k" in mb._inflight_keys)
+        followers = [
+            threading.Thread(target=submit, args=(i,)) for i in range(1, 5)
+        ]
+        for t in followers:
+            t.start()
+        assert _wait_for(lambda: mb.stats()["coalesced"] == 4)
+        gate.set()
+        for t in [leader, *followers]:
+            t.join(timeout=5)
+            assert not t.is_alive()
+        # ONE device call, one query in it, five identical answers
+        assert len(calls) == 1 and calls[0] == ["q"]
+        assert set(results.values()) == {"r:q"}
+        assert mb.stats()["coalesced"] == 4
+        assert not mb._inflight_keys  # key detached after delivery
+        mb.stop()
+
+    def test_failed_batch_fails_every_waiter(self):
+        gate = threading.Event()
+
+        def run_batch(batch):
+            gate.wait(5)
+            raise RuntimeError("device fell over")
+
+        mb = MicroBatcher(run_batch)
+        outcomes = {}
+
+        def submit(i):
+            try:
+                outcomes[i] = mb.submit("q", key="k", timeout=10)
+            except BaseException as e:
+                outcomes[i] = e
+
+        threads = [
+            threading.Thread(target=submit, args=(i,)) for i in range(3)
+        ]
+        threads[0].start()
+        assert _wait_for(lambda: "k" in mb._inflight_keys)
+        for t in threads[1:]:
+            t.start()
+        assert _wait_for(lambda: mb.stats()["coalesced"] == 2)
+        gate.set()
+        for t in threads:
+            t.join(timeout=5)
+            assert not t.is_alive()  # the contract: nobody hangs
+        assert all(
+            isinstance(o, RuntimeError) and "device fell over" in str(o)
+            for o in outcomes.values()
+        )
+        assert not mb._inflight_keys
+        mb.stop()
+
+    def test_distinct_keys_never_coalesce(self):
+        calls = []
+
+        def run_batch(batch):
+            calls.append(list(batch))
+            return [f"r:{q}" for q in batch]
+
+        mb = MicroBatcher(run_batch)
+        assert mb.submit("a", key="ka") == "r:a"
+        assert mb.submit("b", key="kb") == "r:b"
+        # and key=None opts out entirely, even for identical queries
+        assert mb.submit("a") == "r:a"
+        assert mb.submit("a") == "r:a"
+        assert mb.stats()["coalesced"] == 0
+        assert len(calls) == 4
+        mb.stop()
+
+    def test_late_identical_arrival_becomes_fresh_leader(self):
+        calls = []
+
+        def run_batch(batch):
+            calls.append(list(batch))
+            return [f"r:{q}" for q in batch]
+
+        mb = MicroBatcher(run_batch)
+        assert mb.submit("q", key="k") == "r:q"
+        assert mb.submit("q", key="k") == "r:q"  # key was detached: re-runs
+        assert len(calls) == 2 and mb.stats()["coalesced"] == 0
+        mb.stop()
+
+    def test_follower_timeout_leaves_leader_intact(self):
+        gate = threading.Event()
+
+        def run_batch(batch):
+            gate.wait(5)
+            return [f"r:{q}" for q in batch]
+
+        mb = MicroBatcher(run_batch)
+        out = {}
+
+        def lead():
+            out["lead"] = mb.submit("q", key="k", timeout=10)
+
+        t = threading.Thread(target=lead)
+        t.start()
+        assert _wait_for(lambda: "k" in mb._inflight_keys)
+        with pytest.raises(DeadlineExceeded):
+            mb.submit("q", key="k", timeout=0.05)
+        gate.set()
+        t.join(timeout=5)
+        assert out["lead"] == "r:q"
+        mb.stop()
+
+    def test_expired_leader_promotes_live_follower(self):
+        gate = threading.Event()
+        calls = []
+
+        def run_batch(batch):
+            calls.append(list(batch))
+            if len(calls) == 1:
+                gate.wait(5)
+            return [f"r:{q}" for q in batch]
+
+        mb = MicroBatcher(run_batch)
+        out = {}
+
+        def hold():
+            out["hold"] = mb.submit("hold")  # occupies the inline slot
+
+        t_hold = threading.Thread(target=hold)
+        t_hold.start()
+        assert _wait_for(lambda: mb._busy.locked())
+
+        def lead():
+            try:
+                out["lead"] = mb.submit(
+                    "q", key="k", deadline=Deadline.after_ms(60)
+                )
+            except DeadlineExceeded as e:
+                out["lead"] = e
+
+        t_lead = threading.Thread(target=lead)
+        t_lead.start()
+        assert _wait_for(lambda: "k" in mb._inflight_keys)
+
+        def follow():
+            out["follow"] = mb.submit("q", key="k", timeout=10)
+
+        t_follow = threading.Thread(target=follow)
+        t_follow.start()
+        assert _wait_for(
+            lambda: len(mb._inflight_keys["k"].followers) == 1
+        )
+        time.sleep(0.12)  # leader's deadline lapses while queued
+        gate.set()
+        for t in (t_hold, t_lead, t_follow):
+            t.join(timeout=5)
+            assert not t.is_alive()
+        # leader 504s, but its follower was promoted and got the answer
+        assert isinstance(out["lead"], DeadlineExceeded)
+        assert out["follow"] == "r:q"
+        assert not mb._inflight_keys
+        mb.stop()
+
+
+# -- query server integration -------------------------------------------------
+
+
+@pytest.fixture()
+def trained(storage):
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import Event
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.parallel.mesh import MeshContext
+    from predictionio_tpu.templates.recommendation import RecommendationEngine
+
+    store_mod.set_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(App(0, "rcapp"))
+    le = storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(5)
+    events = []
+    for u in range(20):
+        for i in rng.choice(16, size=6, replace=False):
+            events.append(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties={"rating": float(rng.integers(1, 6))},
+                )
+            )
+    le.batch_insert(events, app_id)
+    engine = RecommendationEngine.apply()
+    ep = engine.params_from_variant(
+        {
+            "datasource": {"params": {"appName": "rcapp"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 3}}
+            ],
+        }
+    )
+    ctx = MeshContext.create()
+    run_train(engine, ep, "f", storage=storage, ctx=ctx)
+    yield {
+        "storage": storage, "engine": engine, "ctx": ctx, "ep": ep,
+        "app_id": app_id,
+    }
+    store_mod.set_storage(None)
+
+
+class TestQueryServerCache:
+    def _server(self, trained, **kw):
+        from predictionio_tpu.serving.query_server import QueryServer
+
+        qs = QueryServer(
+            trained["engine"], storage=trained["storage"],
+            ctx=trained["ctx"], **kw,
+        )
+        port = qs.start("127.0.0.1", 0)
+        return qs, f"http://127.0.0.1:{port}"
+
+    def test_hit_serves_identical_answer_and_counts(self, trained):
+        qs, base = self._server(trained, result_cache=ResultCache())
+        try:
+            _, r1 = call("POST", base + "/queries.json", {"user": "u1", "num": 3})
+            _, r2 = call("POST", base + "/queries.json", {"num": 3, "user": "u1"})
+            assert r1 == r2  # field order is canonicalized away
+            _, info = call("GET", base + "/")
+            rc = info["resultCache"]
+            assert rc["hits"] == 1 and rc["stores"] == 1
+        finally:
+            call("POST", base + "/stop")
+
+    def test_event_for_user_invalidates_only_their_answers(self, trained):
+        cache = ResultCache()
+        qs, base = self._server(trained, result_cache=cache)
+        try:
+            call("POST", base + "/queries.json", {"user": "u1", "num": 3})
+            call("POST", base + "/queries.json", {"user": "u2", "num": 3})
+
+            class Ev:
+                event = "rate"
+                entity_id = "u1"
+                target_entity_id = "i999"
+
+            notify_event(Ev())  # what the ingest commit hook fires
+            call("POST", base + "/queries.json", {"user": "u1", "num": 3})
+            call("POST", base + "/queries.json", {"user": "u2", "num": 3})
+            s = cache.stats()
+            assert s["invalidated_event"] == 1  # u1 recomputed
+            assert s["hits"] == 1  # u2 still served from cache
+        finally:
+            call("POST", base + "/stop")
+
+    def test_reload_flushes_result_cache(self, trained):
+        from predictionio_tpu.core.workflow import run_train
+
+        cache = ResultCache()
+        qs, base = self._server(trained, result_cache=cache)
+        try:
+            call("POST", base + "/queries.json", {"user": "u1", "num": 3})
+            assert len(cache) == 1
+            run_train(
+                trained["engine"], trained["ep"], "f",
+                storage=trained["storage"], ctx=trained["ctx"],
+            )
+            status, _ = call("GET", base + "/reload")
+            assert status == 200
+            assert len(cache) == 0  # generation swap cleared everything
+            call("POST", base + "/queries.json", {"user": "u1", "num": 3})
+            assert cache.stats()["stores"] == 2  # recomputed, re-cached
+        finally:
+            call("POST", base + "/stop")
+
+    def test_coalesce_with_batching_serves_consistent_answers(self, trained):
+        qs, base = self._server(trained, batching=True, coalesce=True)
+        try:
+            results = []
+            lock = threading.Lock()
+
+            def fire():
+                s, r = call(
+                    "POST", base + "/queries.json", {"user": "u3", "num": 3}
+                )
+                with lock:
+                    results.append((s, json.dumps(r, sort_keys=True)))
+
+            threads = [threading.Thread(target=fire) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+                assert not t.is_alive()
+            assert all(s == 200 for s, _ in results)
+            assert len({r for _, r in results}) == 1  # one answer, fanned out
+            _, info = call("GET", base + "/")
+            assert "coalesced" in info["batching"]
+        finally:
+            call("POST", base + "/stop")
+
+    def test_metrics_exposition_carries_cache_families(self, trained):
+        qs, base = self._server(
+            trained, result_cache=ResultCache(), coalesce=True, batching=True
+        )
+        try:
+            call("POST", base + "/queries.json", {"user": "u1", "num": 3})
+            call("POST", base + "/queries.json", {"user": "u1", "num": 3})
+            with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+                text = r.read().decode()
+            assert 'pio_result_cache_lookups_total{outcome="hit"} 1' in text
+            assert "pio_result_cache_stores_total 1" in text
+            assert "pio_result_cache_enabled 1" in text
+            assert "pio_coalesce_enabled 1" in text
+            assert "pio_batcher_coalesced_total" in text
+            assert "pio_event_cache_lookups_total" not in text  # no template cache here
+        finally:
+            call("POST", base + "/stop")
+
+
+# -- end-to-end: event server commit → cache invalidation ---------------------
+
+
+@pytest.fixture()
+def ecomm_stack(storage, tmp_path):
+    """Ecommerce engine (unseenOnly, LONG cache refresh) + EventServer in
+    fast-ack mode with a WAL + QueryServer with the result cache on: the
+    full path the acceptance criterion names.  cacheRefreshSeconds is 300
+    so ONLY event-driven invalidation can reveal a new event in time."""
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import Event
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.data.api.event_server import EventServer
+    from predictionio_tpu.data.storage import AccessKey, App
+    from predictionio_tpu.parallel.mesh import MeshContext
+    from predictionio_tpu.serving.query_server import QueryServer
+    from predictionio_tpu.templates.ecommerce import ECommerceEngine
+
+    store_mod.set_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(App(0, "ecapp"))
+    key = storage.get_meta_data_access_keys().insert(AccessKey("", app_id, []))
+    le = storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(13)
+    for u in range(20):
+        for i in rng.choice(12, size=4, replace=False):
+            le.insert(
+                Event(
+                    event="view",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                ),
+                app_id,
+            )
+    engine = ECommerceEngine.apply()
+    ep = engine.params_from_variant(
+        {
+            "datasource": {"params": {"appName": "ecapp"}},
+            "algorithms": [
+                {
+                    "name": "ecomm",
+                    "params": {
+                        "appName": "ecapp", "rank": 4, "numIterations": 4,
+                        "unseenOnly": True, "cacheRefreshSeconds": 300.0,
+                    },
+                }
+            ],
+        }
+    )
+    ctx = MeshContext.create()
+    run_train(engine, ep, "f", storage=storage, ctx=ctx)
+    es = EventServer(
+        storage=storage, ingest_mode="fast", wal_dir=str(tmp_path / "wal")
+    )
+    es_port = es.start(host="127.0.0.1", port=0)
+    qs = QueryServer(
+        engine, storage=storage, ctx=ctx, result_cache=ResultCache()
+    )
+    qs_port = qs.start("127.0.0.1", 0)
+    yield {
+        "qs": f"http://127.0.0.1:{qs_port}",
+        "es": f"http://127.0.0.1:{es_port}",
+        "key": key,
+    }
+    call("POST", f"http://127.0.0.1:{qs_port}/stop")
+    es.stop()
+    store_mod.set_storage(None)
+
+
+class TestEndToEndInvalidation:
+    def test_committed_event_reflects_in_next_query(self, ecomm_stack):
+        base, es, key = (
+            ecomm_stack["qs"], ecomm_stack["es"], ecomm_stack["key"]
+        )
+        q = {"user": "u0", "num": 4}
+        status, r1 = call("POST", base + "/queries.json", q)
+        assert status == 200 and len(r1["itemScores"]) == 4
+        status, r2 = call("POST", base + "/queries.json", q)
+        assert r2 == r1  # second answer came from the result cache
+        top = r1["itemScores"][0]["item"]
+
+        # u0 views the top recommendation — through the WRITE-BEHIND
+        # buffer (fast ack) with the WAL on: the cache must not reveal
+        # the event before the flush commits, and must reveal it after
+        status, body = call(
+            "POST", f"{es}/events.json?accessKey={key}",
+            {
+                "event": "view", "entityType": "user", "entityId": "u0",
+                "targetEntityType": "item", "targetEntityId": top,
+            },
+        )
+        assert status == 202  # fast-acked into the buffer
+
+        def reflected():
+            s, r = call("POST", base + "/queries.json", q)
+            return s == 200 and top not in [
+                i["item"] for i in r["itemScores"]
+            ]
+
+        assert _wait_for(reflected, timeout=10.0), (
+            f"event for u0/{top} committed but queries still serve it"
+        )
+
+    def test_unrelated_user_stays_cached(self, ecomm_stack):
+        base, es, key = (
+            ecomm_stack["qs"], ecomm_stack["es"], ecomm_stack["key"]
+        )
+        call("POST", base + "/queries.json", {"user": "u5", "num": 3})
+        status, body = call(
+            "POST", f"{es}/events.json?accessKey={key}",
+            {
+                "event": "view", "entityType": "user", "entityId": "u6",
+                "targetEntityType": "item", "targetEntityId": "i0",
+            },
+        )
+        assert status == 202
+        time.sleep(0.3)  # let the flush commit and the hook fire
+        call("POST", base + "/queries.json", {"user": "u5", "num": 3})
+        _, info = call("GET", base + "/")
+        rc = info["resultCache"]
+        # u6's event must not have evicted u5's cached answer
+        assert rc["hits"] >= 1 and rc["invalidated_event"] == 0
+
+
+# -- chaos: PIO_FAULT_SPEC must degrade availability, not correctness ---------
+
+
+@pytest.mark.chaos
+class TestCacheChaos:
+    @pytest.fixture(autouse=True)
+    def _no_leaked_faults(self):
+        faults.clear()
+        yield
+        faults.clear()
+
+    def test_fault_spec_shedding_never_corrupts_answers(
+        self, trained, monkeypatch
+    ):
+        from predictionio_tpu.serving.query_server import QueryServer
+
+        qs = QueryServer(
+            trained["engine"], storage=trained["storage"],
+            ctx=trained["ctx"], batching=True,
+            result_cache=ResultCache(), coalesce=True,
+        )
+        port = qs.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            # fault-free reference answers per user
+            expected = {}
+            for u in ("u1", "u2", "u3"):
+                s, r = call(
+                    "POST", base + "/queries.json", {"user": u, "num": 3}
+                )
+                assert s == 200
+                expected[u] = json.dumps(r, sort_keys=True)
+            monkeypatch.setenv(
+                "PIO_FAULT_SPEC",
+                "site=server:queryserver:/queries.json,"
+                "kind=error,status=503,p=0.3",
+            )
+            monkeypatch.setenv("PIO_FAULT_SEED", "7")
+            faults.install(faults._load_env_plan())
+            statuses = []
+            for i in range(40):
+                u = f"u{1 + i % 3}"
+                s, r = call(
+                    "POST", base + "/queries.json", {"user": u, "num": 3}
+                )
+                statuses.append(s)
+                if s == 200:
+                    # chaos may shed, but a served answer is ALWAYS the
+                    # same answer the fault-free server gave
+                    assert json.dumps(r, sort_keys=True) == expected[u]
+            assert 200 in statuses and 503 in statuses  # chaos actually ran
+            faults.clear()
+            s, r = call("POST", base + "/queries.json", {"user": "u1", "num": 3})
+            assert s == 200  # and the server is fine afterwards
+            assert json.dumps(r, sort_keys=True) == expected["u1"]
+        finally:
+            call("POST", base + "/stop")

@@ -115,6 +115,20 @@ def main() -> int:
         "batch_occupancy": http.get("batch_occupancy"),
         "recompiles": http.get("recompiles"),
     }
+    # the Zipf gap: skewed-traffic QPS over uniform QPS with the skew path
+    # on (result cache + single-flight + hot-set). `zipf_gate: false` means
+    # skewed traffic is SLOWER than uniform — the seed measured 0.57x, the
+    # serving caches exist to hold this >= 1.0
+    zipf = http.get("zipf") or {}
+    serving["zipf_ratio"] = zipf.get("ratio_vs_uniform")
+    serving["zipf_hit_rate"] = (zipf.get("zipf") or {}).get("hit_rate")
+    serving["zipf_coalesce_rate"] = (zipf.get("zipf") or {}).get(
+        "coalesce_rate"
+    )
+    serving["zipf_gate"] = (
+        serving["zipf_ratio"] >= 1.0
+        if isinstance(serving["zipf_ratio"], (int, float)) else None
+    )
     artifact["serving"] = serving
     # resilience counters from the same loadtest: a NON-chaos bench run
     # must be clean (zero shed/deadline/degraded) — `clean: false` here is
