@@ -1,0 +1,435 @@
+"""Hot-path hazard detector: host syncs and recompiles in traced code.
+
+On TPU the serving hot path is an AOT-compiled XLA program; three
+classes of Python-side mistakes silently destroy its latency profile:
+
+* **Host-sync forcers** — ``float()``/``int()``/``bool()``/``.item()``/
+  ``.tolist()``/``np.asarray`` on a traced value force a device→host
+  transfer (or fail under trace), turning an async dispatch into a
+  blocking round trip.
+* **Traced branching/loops** — ``if``/``while``/``for`` on a traced
+  value either raises a ``TracerBoolConversionError`` or, with
+  ``static_argnames``, triggers one recompile per distinct value.
+* **Blocking sync outside warmup** — ``block_until_ready`` belongs in
+  compile/warmup paths; in the request path it defeats micro-batching
+  (the repo's one legitimate serving use is fenced behind
+  ``_tracing.active_traces()``, which this rule recognises).
+* **jit in the request path** — tracing+compiling inside a request
+  handler turns one unlucky query into a multi-second stall; compile in
+  ``__init__``/``_compile``/warmup, or suppress with a justification
+  when lazy compilation is the design (see ``models/als.py``).
+
+``static_argnames``/``static_argnums`` parameters are excluded from
+taint — branching on a static arg is the *supported* way to specialise
+(``ops/flash_attention.py`` branches on ``causal`` legitimately).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from predictionio_tpu.analysis.core import (
+    Finding, Module, RepoIndex, analyzer, finding, rel_in, rule,
+)
+
+R_HOST_SYNC = rule(
+    "hotpath-host-sync", "error",
+    "host-sync forcer on a traced value inside a jitted function",
+    "float()/int()/.item()/np.asarray on a tracer forces a device→host "
+    "round trip (or fails under trace)",
+)
+R_TRACED_BRANCH = rule(
+    "hotpath-traced-branch", "error",
+    "Python branch on a traced value inside a jitted function",
+    "raises under trace or recompiles per value; use lax.cond/jnp.where "
+    "or declare the arg static",
+)
+R_TRACED_LOOP = rule(
+    "hotpath-traced-loop", "error",
+    "Python loop over a traced value inside a jitted function",
+    "unrolls/recompiles per shape; use lax.fori_loop/scan or a static "
+    "bound",
+)
+R_BLOCK_OUTSIDE_WARMUP = rule(
+    "hotpath-block-sync", "error",
+    "block_until_ready outside warmup/compile context",
+    "a hard device fence in the request path defeats async dispatch and "
+    "micro-batching; fence only under tracing (active_traces()) or in "
+    "warmup",
+)
+R_JIT_IN_REQUEST = rule(
+    "hotpath-jit-in-request", "error",
+    "jax.jit traced/compiled inside a request-path function",
+    "first-hit compilation stalls a live query for seconds; compile in "
+    "__init__/_compile/warmup instead",
+)
+
+_JIT_NAMES = {"jit", "pjit"}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type"}
+_SYNC_CASTS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist"}
+# enclosing-function names where compilation/fencing is the point
+_WARMUP_NAMES = ("__init__", "_compile", "main")
+_WARMUP_PREFIXES = ("warm", "_warm", "build", "_build", "make", "_make",
+                    "bench", "_bench", "compile", "setup", "_setup")
+# per-query entry points: compiling here stalls a live request.  Training
+# and offline-analytics functions (train_*, cross_occurrence_*) compile
+# lazily by design and are out of scope.
+_REQUEST_PREFIXES = ("recommend", "score", "predict", "query", "handle",
+                     "serve", "submit", "dispatch", "lookup", "rank")
+
+
+def _is_request_path(names: list[str]) -> bool:
+    return any(
+        n.lstrip("_").startswith(_REQUEST_PREFIXES) for n in names
+    )
+
+
+def _is_jit_ref(node: ast.expr) -> bool:
+    """``jit`` / ``jax.jit`` / ``pjit`` / ``jax.experimental...pjit``."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    return False
+
+
+def _static_params(call: Optional[ast.Call], fn: ast.FunctionDef) -> set[str]:
+    """Parameter names declared static via static_argnames/static_argnums."""
+    if call is None:
+        return set()
+    params = [a.arg for a in (
+        fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+    )]
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out.add(v.value)
+        elif kw.arg == "static_argnums":
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in vals:
+                if (
+                    isinstance(v, ast.Constant)
+                    and isinstance(v.value, int)
+                    and 0 <= v.value < len(params)
+                ):
+                    out.add(params[v.value])
+    return out
+
+
+def traced_functions(mod: Module) -> dict[ast.FunctionDef, set[str]]:
+    """Map of jit-traced FunctionDefs → their *static* parameter names.
+
+    Covers ``@jax.jit``, ``@partial(jax.jit, static_argnames=...)``,
+    ``@jax.jit(static_argnames=...)``, ``f = jax.jit(f)`` wrapping, and
+    kernels handed to ``pl.pallas_call``.
+    """
+    if mod.tree is None:
+        return {}
+    out: dict[ast.FunctionDef, set[str]] = {}
+    by_scope_name: dict[tuple[int, str], ast.FunctionDef] = {}
+    parents = mod.parents()
+
+    def scope_of(node: ast.AST) -> int:
+        p = parents.get(node)
+        while p is not None and not isinstance(
+            p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+        ):
+            p = parents.get(p)
+        return id(p)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef):
+            by_scope_name[(scope_of(node), node.name)] = node
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec):
+                    out[node] = set()
+                elif isinstance(dec, ast.Call):
+                    if _is_jit_ref(dec.func):
+                        # @jax.jit(static_argnames=...)
+                        out[node] = _static_params(dec, node)
+                    elif dec.args and _is_jit_ref(dec.args[0]):
+                        # @partial(jax.jit, static_argnames=...)
+                        out[node] = _static_params(dec, node)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target: Optional[str] = None
+        call: Optional[ast.Call] = None
+        if _is_jit_ref(node.func) and node.args and isinstance(
+            node.args[0], ast.Name
+        ):
+            target, call = node.args[0].id, node
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "pallas_call"
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+        ):
+            target, call = node.args[0].id, None
+        if target is None:
+            continue
+        fn = by_scope_name.get((scope_of(node), target))
+        if fn is not None and fn not in out:
+            out[fn] = _static_params(call, fn)
+    return out
+
+
+def _live_taint(
+    expr: ast.AST, tainted: set[str], parents: dict
+) -> Iterable[ast.Name]:
+    """Tainted Name references that still carry tracer-ness: uses under
+    ``.shape``/``.ndim``/``.dtype`` or ``len()``/``isinstance()`` are
+    static metadata, not traced values."""
+    for n in ast.walk(expr):
+        if not (isinstance(n, ast.Name) and n.id in tainted):
+            continue
+        p = parents.get(n)
+        if isinstance(p, ast.Attribute) and p.attr in _SHAPE_ATTRS:
+            continue
+        if isinstance(p, ast.Call) and p.func is not n and getattr(
+            p.func, "id", ""
+        ) in {"len", "isinstance", "type"}:
+            continue
+        yield n
+
+
+def _taint_set(fn: ast.FunctionDef, static: set[str], parents: dict) -> set[str]:
+    params = [a.arg for a in (
+        fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+    )]
+    tainted = {p for p in params if p not in static and p != "self"}
+    # two forward passes approximate a fixpoint over straight-line code
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = node.value
+                if value is None:
+                    continue
+                if not any(_live_taint(value, tainted, parents)):
+                    continue
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            tainted.add(leaf.id)
+    return tainted
+
+
+def _numpy_aliases(mod: Module) -> set[str]:
+    out = set()
+    if mod.tree is None:
+        return out
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+        elif isinstance(node, ast.ImportFrom) and node.module == "numpy":
+            for a in node.names:
+                out.add(a.asname or a.name)
+    return out
+
+
+def _enclosing_functions(node: ast.AST, parents: dict) -> list[str]:
+    names = []
+    p = parents.get(node)
+    while p is not None:
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.append(p.name)
+        p = parents.get(p)
+    return names
+
+
+def _in_warmup_context(node: ast.AST, parents: dict) -> bool:
+    for name in _enclosing_functions(node, parents):
+        if name in _WARMUP_NAMES or name.startswith(_WARMUP_PREFIXES) \
+                or "warmup" in name:
+            return True
+    # fenced behind the tracing sampler: `if _tracing.active_traces():`
+    p = parents.get(node)
+    while p is not None:
+        if isinstance(p, ast.If):
+            for n in ast.walk(p.test):
+                if isinstance(n, ast.Attribute) and \
+                        n.attr == "active_traces":
+                    return True
+                if isinstance(n, ast.Name) and n.id == "active_traces":
+                    return True
+        p = parents.get(p)
+    return False
+
+
+def _check_traced_body(
+    mod: Module, fn: ast.FunctionDef, static: set[str]
+) -> list[Finding]:
+    parents = mod.parents()
+    tainted = _taint_set(fn, static, parents)
+    np_alias = _numpy_aliases(mod)
+    out: list[Finding] = []
+    inner_traced = {
+        f for f in ast.walk(fn)
+        if isinstance(f, ast.FunctionDef) and f is not fn
+    }
+
+    def in_nested_def(node: ast.AST) -> bool:
+        p = parents.get(node)
+        while p is not None and p is not fn:
+            if p in inner_traced:
+                return True
+            p = parents.get(p)
+        return False
+
+    for node in ast.walk(fn):
+        if in_nested_def(node):
+            continue  # nested defs get their own pass if jitted
+        if isinstance(node, ast.Call):
+            callee = node.func
+            cname = getattr(callee, "id", "")
+            cattr = callee.attr if isinstance(callee, ast.Attribute) else ""
+            args_tainted = any(
+                any(_live_taint(a, tainted, parents))
+                for a in list(node.args)
+                + [kw.value for kw in node.keywords]
+            )
+            if cname in _SYNC_CASTS and args_tainted:
+                out.append(finding(
+                    R_HOST_SYNC, mod, node.lineno,
+                    f"{cname}() on a traced value in jitted "
+                    f"{fn.name!r} forces a host sync",
+                    symbol=f"{fn.name}.{cname}",
+                ))
+            elif cattr in _SYNC_METHODS and any(
+                _live_taint(callee.value, tainted, parents)
+            ):
+                out.append(finding(
+                    R_HOST_SYNC, mod, node.lineno,
+                    f".{cattr}() on a traced value in jitted "
+                    f"{fn.name!r} forces a host sync",
+                    symbol=f"{fn.name}.{cattr}",
+                ))
+            elif (
+                isinstance(callee, ast.Attribute)
+                and isinstance(callee.value, ast.Name)
+                and callee.value.id in np_alias
+                and args_tainted
+            ):
+                out.append(finding(
+                    R_HOST_SYNC, mod, node.lineno,
+                    f"numpy call {callee.value.id}.{cattr}() on a "
+                    f"traced value in jitted {fn.name!r} forces a "
+                    "host transfer",
+                    symbol=f"{fn.name}.np.{cattr}",
+                ))
+            elif cattr in {"device_get", "block_until_ready"} or \
+                    cname == "device_get":
+                out.append(finding(
+                    R_HOST_SYNC, mod, node.lineno,
+                    f"{cattr or cname}() inside jitted {fn.name!r} "
+                    "forces a host sync",
+                    symbol=f"{fn.name}.{cattr or cname}",
+                ))
+        elif isinstance(node, (ast.If, ast.While)):
+            hits = list(_live_taint(node.test, tainted, parents))
+            if hits:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                out.append(finding(
+                    R_TRACED_BRANCH, mod, node.lineno,
+                    f"Python `{kind}` on traced value "
+                    f"{hits[0].id!r} in jitted {fn.name!r}; use "
+                    "lax.cond/jnp.where or declare it static",
+                    symbol=f"{fn.name}.{hits[0].id}",
+                ))
+        elif isinstance(node, ast.For):
+            hits = list(_live_taint(node.iter, tainted, parents))
+            if hits:
+                out.append(finding(
+                    R_TRACED_LOOP, mod, node.lineno,
+                    f"Python `for` over traced value {hits[0].id!r} "
+                    f"in jitted {fn.name!r}; use lax.fori_loop/scan",
+                    symbol=f"{fn.name}.{hits[0].id}",
+                ))
+    return out
+
+
+@analyzer("hotpath")
+def analyze(index: RepoIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in index.modules:
+        if mod.tree is None:
+            continue
+        traced = traced_functions(mod)
+        for fn, static in traced.items():
+            out.extend(_check_traced_body(mod, fn, static))
+        if not rel_in(mod.rel, "serving", "models", "ops"):
+            continue
+        parents = mod.parents()
+        traced_nodes = set()
+        for fn in traced:
+            traced_nodes.update(ast.walk(fn))
+        for node in ast.walk(mod.tree):
+            if node in traced_nodes or not isinstance(node, ast.Call):
+                continue
+            cattr = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else getattr(node.func, "id", "")
+            )
+            if cattr == "block_until_ready":
+                if not _in_warmup_context(node, parents):
+                    encl = _enclosing_functions(node, parents)
+                    where = encl[0] if encl else "<module>"
+                    out.append(finding(
+                        R_BLOCK_OUTSIDE_WARMUP, mod, node.lineno,
+                        f"block_until_ready in {where!r} outside "
+                        "warmup; fence only under active_traces() or "
+                        "in warmup/compile paths",
+                        symbol=where,
+                    ))
+            elif _is_jit_ref(node.func) and rel_in(
+                mod.rel, "serving", "models"
+            ):
+                encl = _enclosing_functions(node, parents)
+                if encl and _is_request_path(encl) and \
+                        not _in_warmup_context(node, parents):
+                    out.append(finding(
+                        R_JIT_IN_REQUEST, mod, node.lineno,
+                        f"jax.jit call inside {encl[0]!r} compiles in "
+                        "the request path; move to __init__/_compile/"
+                        "warmup",
+                        symbol=encl[0],
+                    ))
+        # @jax.jit decorators on defs nested inside request-path functions
+        if rel_in(mod.rel, "serving", "models"):
+            for fn in traced:
+                encl = _enclosing_functions(fn, parents)
+                if encl and _is_request_path(encl) and \
+                        not _in_warmup_context(fn, parents):
+                    out.append(finding(
+                        R_JIT_IN_REQUEST, mod, fn.lineno,
+                        f"@jit function {fn.name!r} defined inside "
+                        f"{encl[0]!r} compiles in the request path; "
+                        "move to __init__/_compile/warmup",
+                        symbol=f"{encl[0]}.{fn.name}",
+                    ))
+    return out
+
+from predictionio_tpu.analysis.core import owns_rules
+
+owns_rules("hotpath", R_HOST_SYNC.id, R_TRACED_BRANCH.id, R_TRACED_LOOP.id,
+           R_BLOCK_OUTSIDE_WARMUP.id, R_JIT_IN_REQUEST.id)

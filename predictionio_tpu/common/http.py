@@ -97,7 +97,9 @@ def _date_hdr() -> bytes:
         hdr = ("Date: " + email.utils.formatdate(now, usegmt=True) + "\r\n").encode(
             "ascii"
         )
-        _DATE_CACHE = (now, hdr)
+        # racing threads rebuild the same (second, header) pair; last
+        # write wins and every value is correct, so no lock is needed
+        _DATE_CACHE = (now, hdr)  # pio: ignore[race-global-write]
     return hdr
 
 
@@ -132,7 +134,9 @@ class HttpService:
             self.routes.append((method.upper(), regex, fn))
             literal = pattern.replace(r"\.", ".")
             if not any(c in literal for c in "[](){}?*+|^$\\"):
-                self._exact[(method.upper(), literal)] = fn
+                # routes are registered during service construction,
+                # strictly before start() spawns the accept thread
+                self._exact[(method.upper(), literal)] = fn  # pio: ignore[race-unguarded-rmw]
             return fn
 
         return deco

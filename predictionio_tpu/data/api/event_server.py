@@ -110,6 +110,9 @@ class EventServer:
             else _env_num("PIO_DRAIN_TIMEOUT_MS", 5000.0, float)
         )
         self._draining = False
+        # drain() is reachable from SIGTERM, POST /stop, and stop();
+        # the flag and counters share one lock across those threads
+        self._drain_lock = threading.Lock()
         self._drain_counts = {"drains": 0, "drained_events": 0,
                               "abandoned_events": 0}
         self._stopped = False
@@ -685,16 +688,19 @@ class EventServer:
         budget_s = (
             timeout_ms if timeout_ms is not None else self.drain_timeout_ms
         ) / 1e3
-        self._draining = True
-        self._drain_counts["drains"] += 1
+        with self._drain_lock:
+            self._draining = True
+            self._drain_counts["drains"] += 1
         clean = True
         if self.ingest_buffer is not None:
             before = self.ingest_buffer.stats()["buffered"]
             drained = self.ingest_buffer.close(timeout=max(budget_s, 0.0))
             left = self.ingest_buffer.stats()["buffered"]
-            self._drain_counts["drained_events"] += max(before - left, 0)
+            with self._drain_lock:
+                self._drain_counts["drained_events"] += max(before - left, 0)
             if not drained or left:
-                self._drain_counts["abandoned_events"] += left
+                with self._drain_lock:
+                    self._drain_counts["abandoned_events"] += left
                 logger.warning(
                     "drain budget (%.0fms) lapsed with %d events unflushed",
                     budget_s * 1e3, left,
@@ -709,7 +715,8 @@ class EventServer:
             except Exception:
                 logger.exception("LEvents close failed during drain")
         self.service.stop()
-        self._stopped = True
+        with self._drain_lock:
+            self._stopped = True
         return clean
 
     def stop(self) -> None:

@@ -768,7 +768,10 @@ class _Client:
             return br
 
     def _note_retry(self, attempt: int, exc: BaseException) -> None:
-        self.retry_count += 1
+        # fires as the resilience on_retry callback on whatever thread is
+        # mid-call; the counter shares the breaker-map lock
+        with self._breakers_lock:
+            self.retry_count += 1
         self._rl_log.warning(
             "retry", "storage call failed (%s); retry %d", exc, attempt
         )

@@ -1234,7 +1234,10 @@ class ALSScorer:
                 with self._batch_init_lock:
                     if not hasattr(self, "_score_batch"):
 
+                        # lazy one-time compile, double-checked under
+                        # _batch_init_lock: only the first query pays it
                         @jax.jit
+                        # pio: ignore[hotpath-jit-in-request]
                         def _score_batch(U, V, pad_mask, u_idx):
                             scores = U[u_idx] @ V.T  # (B, pad)
                             scores = jnp.where(

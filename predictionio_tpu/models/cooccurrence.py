@@ -411,7 +411,7 @@ def cross_occurrence_topn(
     elif host_reduce is not None:
         # multi-host: accumulate locally, reduce the block across hosts,
         # THEN score/top-k — top-k does not commute with the host sum
-        run_acc = jax.jit(accumulate_block)
+        run_acc = jax.jit(accumulate_block, static_argnames=("varying",))
         run_score = jax.jit(score_block)
         for bi in range(len(starts)):
             blocked_s, s_counts, start, width = build_block(bi)
@@ -428,7 +428,7 @@ def cross_occurrence_topn(
             out_scores[start : start + width] = np.asarray(vals)[:width]
             out_items[start : start + width] = np.asarray(idx)[:width]
     else:
-        run_block = jax.jit(block_kernel)
+        run_block = jax.jit(block_kernel, static_argnames=("varying",))
         for bi in range(len(starts)):
             blocked_s, s_counts, start, width = build_block(bi)
             vals, idx = run_block(

@@ -418,7 +418,10 @@ class MicroBatcher:
         except BaseException as e:  # propagate to EVERY waiter
             run_error = e
         run_dt = time.perf_counter() - t_run
-        self._ewma_run += self.ALPHA * (run_dt - self._ewma_run)
+        # both the worker thread and the trickle bypass land here; the
+        # estimator shares _arr_lock with the gap EWMA
+        with self._arr_lock:
+            self._ewma_run += self.ALPHA * (run_dt - self._ewma_run)
         for i, p in enumerate(batch):
             if run_error is not None:
                 self._resolve(p, error=run_error)

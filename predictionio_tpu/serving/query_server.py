@@ -277,7 +277,8 @@ class QueryServer:
                 last_good = self._deployed
             if last_good is not None:
                 self.counters.inc("reload_failed")
-                self._reload_degraded = True
+                with self._lock:
+                    self._reload_degraded = True
                 self._rl_log.exception(
                     "reload", "reload failed; serving last good instance %s",
                     last_good.instance_id,
@@ -316,7 +317,8 @@ class QueryServer:
         with self._lock:
             self._deployed = deployed
         self._note_generation_swap()
-        self._reload_degraded = False
+        with self._lock:
+            self._reload_degraded = False
         self._record_last_known_good(instance.id)
         logger.info("deployed engine instance %s", instance.id)
         return instance.id
@@ -325,7 +327,10 @@ class QueryServer:
         """A new model generation is live: bump the serving generation (the
         result cache's model tag) and flush — answers computed against the
         previous generation must never be served against this one."""
-        self._serving_gen += 1
+        # handler threads read the generation per query; the bump comes
+        # from reload/cold-start threads, so it takes the server lock
+        with self._lock:
+            self._serving_gen += 1
         if self._result_cache is not None:
             self._result_cache.clear()
 
@@ -400,7 +405,8 @@ class QueryServer:
                 self._deployed = deployed
             self._note_generation_swap()
             self.counters.inc("reload_failed")
-            self._reload_degraded = True
+            with self._lock:
+                self._reload_degraded = True
             self._record_last_known_good(iid)
             logger.warning(
                 "cold start: newest instance %s unusable; serving "
@@ -646,7 +652,10 @@ class QueryServer:
             # remember the newest good answer for the degraded path; shallow
             # copy so prId/plugin rewrites never leak back into the cache
             if isinstance(result, dict):
-                self._last_good = dict(result)
+                # every handler thread writes this; order the rebinds so
+                # the degraded path always sees a complete answer
+                with self._lock:
+                    self._last_good = dict(result)
             if (
                 cache is not None
                 and fp is not None
@@ -934,7 +943,8 @@ class QueryServer:
         budget_s = (
             timeout_ms if timeout_ms is not None else self.drain_timeout_ms
         ) / 1e3
-        self._draining = True
+        with self._lock:
+            self._draining = True
         deadline = time.monotonic() + max(budget_s, 0.0)
         while time.monotonic() < deadline:
             with self._inflight_lock:

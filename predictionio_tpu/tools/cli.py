@@ -689,6 +689,63 @@ def cmd_import(args) -> int:
     return 0
 
 
+def _git_changed(root: str) -> set[str]:
+    """Repo-relative paths that differ from HEAD, plus untracked files."""
+    import subprocess
+
+    paths: set[str] = set()
+    for argv in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        out = subprocess.run(
+            argv, cwd=root, capture_output=True, text=True, check=False
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"--changed-only needs a git checkout: {out.stderr.strip()}"
+            )
+        paths.update(p.strip() for p in out.stdout.splitlines() if p.strip())
+    return paths
+
+
+def cmd_analyze(args) -> int:
+    import importlib
+
+    from predictionio_tpu.analysis import core
+
+    # import-for-effect: the package __init__ registers every analyzer
+    importlib.import_module("predictionio_tpu.analysis")
+    if args.list_rules:
+        for name in sorted(core.ANALYZER_RULES):
+            for rid in core.ANALYZER_RULES[name]:
+                r = core.RULES[rid]
+                print(f"{rid:28} {r.severity:8} [{name}] {r.summary}")
+        return 0
+    root = args.root
+    names = args.analyzers.split(",") if args.analyzers else None
+    changed = _git_changed(root) if args.changed_only else None
+    baseline_path = args.baseline or os.path.join(root, core.BASELINE_NAME)
+    rep = core.run(
+        root,
+        analyzers=names,
+        # "" never names a file, so a --write-baseline run sees every
+        # finding instead of hiding the currently-acknowledged ones
+        baseline_path="" if args.write_baseline else baseline_path,
+        changed_only=changed,
+    )
+    if args.write_baseline:
+        core.write_baseline(baseline_path, rep.findings)
+        print(f"[INFO] Acknowledged {len(rep.findings)} finding(s) in "
+              f"{baseline_path}")
+        return 0
+    if args.format == "json":
+        print(json.dumps(rep.to_dict(), indent=2))
+    else:
+        print(rep.render())
+    return 1 if rep.errors else 0
+
+
 # -- parser --------------------------------------------------------------------
 
 
@@ -958,6 +1015,33 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--input", required=True)
     sp.add_argument("--channel", default=None)
     sp.set_defaults(func=cmd_import)
+
+    sp = sub.add_parser(
+        "analyze",
+        help="whole-repo static analysis: hot-path hazards, races, "
+        "knob/metric contract drift (docs/analysis.md)",
+    )
+    sp.add_argument("--root", default=".",
+                    help="repo root to analyze (default: cwd)")
+    sp.add_argument("--format", choices=("human", "json"), default="human")
+    sp.add_argument("--analyzers", default=None,
+                    help="comma-separated subset (default: all registered)")
+    sp.add_argument(
+        "--changed-only", action="store_true",
+        help="report only findings in files changed vs HEAD (plus "
+        "untracked); analyzers still see the whole repo",
+    )
+    sp.add_argument("--baseline", default=None,
+                    help="baseline path (default: "
+                    "<root>/.pio-analysis-baseline.json)")
+    sp.add_argument(
+        "--write-baseline", action="store_true",
+        help="acknowledge every current finding into the baseline "
+        "instead of reporting",
+    )
+    sp.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    sp.set_defaults(func=cmd_analyze)
 
     return p
 

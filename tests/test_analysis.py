@@ -1,0 +1,446 @@
+"""Tests for the `pio analyze` static-analysis subsystem.
+
+Each analyzer gets a minimal fixture tree that triggers its rules
+(positives) and a repo-idiom twin that must stay clean (negatives), so
+a loosened heuristic and an over-eager one both fail loudly.  The
+framework pieces — suppressions, baseline, JSON schema, the knob
+registry — are tested round-trip, and the real checkout must analyze
+clean (zero errors) because `pio analyze` gates tier-1.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from predictionio_tpu.analysis.core import (
+    BASELINE_NAME, RepoIndex, load_baseline, run, write_baseline,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_repo(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return str(tmp_path)
+
+
+def by_rule(report, rule_id):
+    return [f for f in report.findings if f.rule == rule_id]
+
+
+def symbols(report, rule_id):
+    return {f.symbol for f in by_rule(report, rule_id)}
+
+
+# -- framework ----------------------------------------------------------------
+
+
+def test_finding_key_is_line_independent(tmp_path):
+    root = make_repo(tmp_path, {"a.py": "import os\n"})
+    k1 = run(root, analyzers=["hygiene"]).findings[0].key
+    # push the import down two lines: the key must not move
+    (tmp_path / "a.py").write_text('"""doc."""\n\nimport os\n')
+    k2 = run(root, analyzers=["hygiene"]).findings[0].key
+    assert k1 == k2
+    assert "a.py" in k1 and "os" in k1
+
+
+def test_inline_suppression_same_line_and_standalone(tmp_path):
+    root = make_repo(tmp_path, {
+        "a.py": "import os  # pio: ignore[hygiene-unused-import]\n",
+        "b.py": "# pio: ignore[hygiene-unused-import]\nimport sys\n",
+        "c.py": "import json  # pio: ignore\n",
+        "d.py": "import re\n",
+    })
+    rep = run(root, analyzers=["hygiene"])
+    assert symbols(rep, "hygiene-unused-import") == {"re"}
+    assert rep.suppressed == 3
+
+
+def test_suppression_for_other_rule_does_not_waive(tmp_path):
+    root = make_repo(tmp_path, {
+        "a.py": "import os  # pio: ignore[hotpath-host-sync]\n",
+    })
+    rep = run(root, analyzers=["hygiene"])
+    assert symbols(rep, "hygiene-unused-import") == {"os"}
+
+
+def test_baseline_round_trip(tmp_path):
+    root = make_repo(tmp_path, {"a.py": "import os\nimport sys\n"})
+    rep = run(root, analyzers=["hygiene"])
+    assert len(rep.findings) == 2 and rep.baselined == 0
+    baseline = os.path.join(root, BASELINE_NAME)
+    write_baseline(baseline, rep.findings)
+    assert len(load_baseline(baseline)) == 2
+    again = run(root, analyzers=["hygiene"])
+    assert again.findings == [] and again.baselined == 2
+    # a NEW finding still reports: the baseline is debt, not a blindfold
+    (tmp_path / "b.py").write_text("import json\n")
+    third = run(root, analyzers=["hygiene"])
+    assert symbols(third, "hygiene-unused-import") == {"json"}
+
+
+def test_baseline_rejects_unknown_format(tmp_path):
+    p = tmp_path / "base.json"
+    p.write_text('{"version": 9, "findings": []}')
+    with pytest.raises(ValueError):
+        load_baseline(str(p))
+
+
+def test_unknown_analyzer_raises(tmp_path):
+    root = make_repo(tmp_path, {"a.py": "x = 1\n"})
+    with pytest.raises(ValueError):
+        run(root, analyzers=["nope"])
+
+
+def test_changed_only_scopes_the_report(tmp_path):
+    root = make_repo(tmp_path, {
+        "a.py": "import os\n",
+        "b.py": "import sys\n",
+    })
+    rep = run(root, analyzers=["hygiene"], changed_only={"a.py"})
+    assert symbols(rep, "hygiene-unused-import") == {"os"}
+
+
+def test_report_json_schema(tmp_path):
+    root = make_repo(tmp_path, {"a.py": "import os\n"})
+    d = run(root, analyzers=["hygiene"]).to_dict()
+    assert d["version"] == 1
+    assert set(d["counts"]) == {"error", "warning", "info"}
+    for key in ("root", "analyzers", "suppressed", "baselined", "findings"):
+        assert key in d
+    f = d["findings"][0]
+    assert set(f) == {
+        "rule", "severity", "path", "line", "message", "symbol", "key",
+    }
+    json.dumps(d)  # must be serializable as-is
+
+
+# -- hotpath ------------------------------------------------------------------
+
+
+HOTPATH_FIXTURE = {
+    "models/jitted.py": """\
+        import jax
+
+        @jax.jit
+        def bad_branch(x):
+            if x:
+                return x
+            return -x
+
+        @jax.jit
+        def bad_sync(x):
+            return float(x)
+
+        @jax.jit
+        def bad_loop(xs):
+            total = 0
+            for v in xs:
+                total = total + v
+            return total
+
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("flag",))
+        def ok_static(x, flag):
+            if flag:
+                return x * 2
+            return x
+
+        @jax.jit
+        def ok_shape(x):
+            if x.ndim == 2:
+                return x.sum()
+            return x
+    """,
+    "serving/warm.py": """\
+        import jax
+
+        def handle_query(model, x):
+            y = model(x)
+            y.block_until_ready()
+            return y
+
+        def warmup(model):
+            out = model(0)
+            out.block_until_ready()
+            return out
+
+        def recommend(model, q):
+            f = jax.jit(model)
+            return f(q)
+
+        def _compile_scorer(model):
+            return jax.jit(model)
+    """,
+}
+
+
+def test_hotpath_positives_and_negatives(tmp_path):
+    root = make_repo(tmp_path, HOTPATH_FIXTURE)
+    rep = run(root, analyzers=["hotpath"])
+    assert symbols(rep, "hotpath-traced-branch") == {"bad_branch.x"}
+    assert symbols(rep, "hotpath-host-sync") == {"bad_sync.float"}
+    assert symbols(rep, "hotpath-traced-loop") == {"bad_loop.xs"}
+    assert symbols(rep, "hotpath-block-sync") == {"handle_query"}
+    assert symbols(rep, "hotpath-jit-in-request") == {"recommend"}
+    # static args, shape checks, warmup fences, compile helpers: clean
+    all_syms = {f.symbol for f in rep.findings}
+    assert not any("ok_static" in s or "ok_shape" in s or
+                   "warmup" in s or "_compile" in s for s in all_syms)
+
+
+# -- races --------------------------------------------------------------------
+
+
+RACES_FIXTURE = {
+    "serving/state.py": """\
+        import threading
+
+        class Unguarded:
+            def __init__(self):
+                self._n = 0
+
+            def bump(self):
+                self._n += 1
+
+            def read(self):
+                return self._n
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def read(self):
+                with self._lock:
+                    return self._n
+    """,
+    "common/plan.py": """\
+        import threading
+
+        _lock = threading.Lock()
+        _plan = None
+        _other = None
+
+        def set_plan(p):
+            global _plan
+            with _lock:
+                _plan = p
+
+        def set_other(p):
+            global _other
+            _other = p
+    """,
+}
+
+
+def test_races_positives_and_negatives(tmp_path):
+    root = make_repo(tmp_path, RACES_FIXTURE)
+    rep = run(root, analyzers=["races"])
+    rmw = symbols(rep, "race-unguarded-rmw")
+    assert any("Unguarded" in s for s in rmw)
+    assert not any("Guarded." in s for s in rmw)
+    # module globals: unlocked rebind flags, `with _lock:` rebind doesn't
+    glob = symbols(rep, "race-global-write")
+    assert any("_other" in s for s in glob)
+    assert not any("_plan" in s for s in glob)
+
+
+# -- knobs --------------------------------------------------------------------
+
+
+KNOBS_FIXTURE = {
+    "common/config.py": """\
+        import os
+
+        FOO = os.environ.get("PIO_FIX_FOO", "7")
+        BAZ = int(os.environ.get("PIO_FIX_BAZ", "5"))
+        A = os.environ.get("PIO_FIX_DUP", "1")
+        B = os.environ.get("PIO_FIX_DUP", "2")
+    """,
+    "docs/operations.md": """\
+        # Ops
+
+        | env var | default | meaning |
+        |---|---|---|
+        | `PIO_FIX_BAZ` | 6 | documented with the WRONG default |
+        | `PIO_FIX_DUP` | 1 | read twice with different defaults |
+        | `PIO_FIX_DEAD` | 1 | documented but read nowhere |
+    """,
+}
+
+
+def test_knobs_contract_rules(tmp_path):
+    root = make_repo(tmp_path, KNOBS_FIXTURE)
+    rep = run(root, analyzers=["knobs"])
+    assert symbols(rep, "knob-undocumented") == {"PIO_FIX_FOO"}
+    assert symbols(rep, "knob-default-mismatch") == {"PIO_FIX_BAZ"}
+    assert symbols(rep, "knob-inconsistent-default") == {"PIO_FIX_DUP"}
+    assert symbols(rep, "knob-dead-doc") == {"PIO_FIX_DEAD"}
+    knobs = rep.extras["knobs"]
+    assert knobs["count"] == 3  # FOO, BAZ, DUP
+    assert knobs["documented"] == 2
+    entries = {e["name"]: e for e in knobs["entries"]}
+    assert entries["PIO_FIX_BAZ"]["type"] == "int"
+    assert entries["PIO_FIX_FOO"]["documented"] is False
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+METRICS_FIXTURE = {
+    "obs/m.py": """\
+        def setup(reg):
+            reg.counter("pio_fix_undoc_total", "d")
+            reg.counter("pio_fix_typed_total", "d")
+            reg.gauge("pio_fix_labeled", "d", ("user",))
+            reg.counter("pio_fix_ok_total", "d", ("outcome",))
+            reg.gauge("pio_fix_bad_name_total", "d")
+    """,
+    "docs/observability.md": r"""
+        # Observability
+
+        | metric | type | meaning |
+        |---|---|---|
+        | `pio_fix_typed_total` | gauge | wrong type on purpose |
+        | `pio_fix_ok_total{outcome=hit\|miss}` | counter | labeled row parses |
+        | `pio_fix_bad_name_total` | gauge | gauge named like a counter |
+        | `pio_fix_dead_total` | counter | registered nowhere |
+    """,
+}
+
+
+def test_metrics_contract_rules(tmp_path):
+    root = make_repo(tmp_path, METRICS_FIXTURE)
+    rep = run(root, analyzers=["metrics"])
+    assert symbols(rep, "metric-undocumented") == {
+        "pio_fix_undoc_total", "pio_fix_labeled",
+    }
+    assert symbols(rep, "metric-type-mismatch") == {"pio_fix_typed_total"}
+    assert symbols(rep, "metric-dead-doc") == {"pio_fix_dead_total"}
+    assert symbols(rep, "metric-label-cardinality") == {"pio_fix_labeled"}
+    assert symbols(rep, "metric-naming") == {"pio_fix_bad_name_total"}
+    # the catalog row with an inline label set (and an escaped pipe)
+    # counts as documentation — pio_fix_ok_total is fully clean
+    assert not any(f.symbol == "pio_fix_ok_total" for f in rep.findings)
+
+
+# -- blocking -----------------------------------------------------------------
+
+
+BLOCKING_FIXTURE = {
+    "serving/batching.py": """\
+        import json
+        import time
+
+        class Batcher:
+            def dispatch(self, batch):
+                time.sleep(0.001)
+                return json.dumps(batch)
+
+            def _wait(self, cv):
+                cv.wait()
+                return self.send(1)
+
+            def send(self, x):
+                return x
+    """,
+    "data/api/flusher.py": """\
+        import time
+
+        class Flusher:
+            def _flush(self):
+                time.sleep(0.01)
+
+            def enqueue(self, x):
+                time.sleep(0.01)  # not a hot-loop name: out of scope
+                return x
+    """,
+}
+
+
+def test_blocking_positives_and_negatives(tmp_path):
+    root = make_repo(tmp_path, BLOCKING_FIXTURE)
+    rep = run(root, analyzers=["blocking"])
+    syms = symbols(rep, "blocking-call-in-hot-loop")
+    assert syms == {"dispatch.sleep", "dispatch.dumps", "_flush.sleep"}
+
+
+# -- the real checkout --------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    return run(ROOT)
+
+
+def test_repo_analyzes_clean(repo_report):
+    errs = [f.render() for f in repo_report.findings
+            if f.severity == "error"]
+    assert repo_report.errors == 0, "\n".join(errs)
+
+
+def test_repo_knob_registry_is_fully_documented(repo_report):
+    knobs = repo_report.extras["knobs"]
+    undocumented = [e["name"] for e in knobs["entries"]
+                    if not e["documented"]]
+    assert knobs["count"] == knobs["documented"], undocumented
+    assert knobs["count"] > 0
+
+
+def test_repo_metric_catalog_is_fully_documented(repo_report):
+    metrics = repo_report.extras["metrics"]
+    assert metrics["count"] == metrics["documented"]
+    assert metrics["count"] > 0
+
+
+def test_repo_baseline_keys_all_load(repo_report):
+    keys = load_baseline(os.path.join(ROOT, BASELINE_NAME))
+    assert all(isinstance(k, str) and k.count(":") >= 2 for k in keys)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_analyze_json(tmp_path, capsys):
+    from predictionio_tpu.tools.cli import main
+
+    root = make_repo(tmp_path, {"a.py": "import os\n"})
+    code = main(["analyze", "--format", "json", "--root", root])
+    d = json.loads(capsys.readouterr().out)
+    assert code == 1  # unused import is an error
+    assert d["counts"]["error"] == 1
+    assert d["findings"][0]["rule"] == "hygiene-unused-import"
+
+
+def test_cli_analyze_write_baseline_then_clean(tmp_path, capsys):
+    from predictionio_tpu.tools.cli import main
+
+    root = make_repo(tmp_path, {"a.py": "import os\n"})
+    assert main(["analyze", "--root", root, "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert main(["analyze", "--root", root]) == 0
+    out = capsys.readouterr().out
+    assert "1 baselined" in out
+
+
+def test_cli_list_rules(capsys):
+    from predictionio_tpu.tools.cli import main
+
+    assert main(["analyze", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("hotpath-host-sync", "race-unguarded-rmw",
+                "knob-undocumented", "metric-undocumented",
+                "blocking-call-in-hot-loop", "hygiene-unused-import"):
+        assert rid in out

@@ -2,226 +2,46 @@
 
 Parity role: the reference's scalastyle gate in tests/unit.sh:30-35 — a
 cheap hygiene check run with the unit suite.
+
+These are now thin shims over the ``hygiene`` analyzer in
+``predictionio_tpu/analysis`` — one engine, one suppression mechanism,
+one baseline (see docs/analysis.md).  The test names are kept stable so
+CI history stays comparable across the migration.
 """
 
-import ast
 import os
 
 import pytest
 
-PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "predictionio_tpu")
+from predictionio_tpu.analysis.core import run
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def iter_modules():
-    for root, dirs, files in os.walk(PKG):
-        dirs[:] = [d for d in dirs if not d.startswith("__")]
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(root, f)
+@pytest.fixture(scope="module")
+def hygiene_report():
+    return run(ROOT, analyzers=["hygiene"])
 
 
-def unused_imports(path: str) -> list[str]:
-    src = open(path).read()
-    tree = ast.parse(src)
-    imported: dict[str, int] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                imported[(a.asname or a.name).split(".")[0]] = node.lineno
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue
-            for a in node.names:
-                if a.name != "*":
-                    imported[a.asname or a.name] = node.lineno
-    used = set()
-    for node in ast.walk(tree):
-        n = node
-        while isinstance(n, ast.Attribute):
-            n = n.value
-        if isinstance(n, ast.Name):
-            used.add(n.id)
-    in_all = set()
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Assign)
-            and any(
-                isinstance(t, ast.Name) and t.id == "__all__" for t in node.targets
-            )
-            and isinstance(node.value, (ast.List, ast.Tuple))
-        ):
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant):
-                    in_all.add(elt.value)
-    return [
-        f"{path}:{lineno}: unused import {name}"
-        for name, lineno in imported.items()
-        if name not in used and name not in in_all
-    ]
+def _by_rule(report, rule_id):
+    return [f.render() for f in report.findings if f.rule == rule_id]
 
 
-def test_no_unused_imports():
-    issues = [issue for path in iter_modules() for issue in unused_imports(path)]
+def test_all_modules_parse(hygiene_report):
+    issues = _by_rule(hygiene_report, "hygiene-syntax")
     assert not issues, "\n".join(issues)
 
 
-def test_all_modules_parse():
-    for path in iter_modules():
-        ast.parse(open(path).read(), filename=path)
-
-
-# -- telemetry hygiene: no ad-hoc module-level counters -----------------------
-
-# Legacy module-level counters that predate the obs registry, grandfathered
-# as "path:target". EMPTY as of the obs PR — every global counter found by
-# this lint after that point is a regression: new aggregates belong on the
-# server's MetricsRegistry (or behind a bridge in obs/bridges.py), not in
-# module globals that /metrics can't see.
-COUNTER_ALLOWLIST: set[str] = set()
-
-_COUNTERISH_CALLS = {"Counter", "ErrorCounters", "defaultdict"}
-_COUNTERISH_NAMES = ("_count", "_counts", "_counter", "_counters", "_stats")
-
-
-def module_level_counters(path: str) -> list[str]:
-    """Module-level assignments that smell like an ad-hoc metrics store:
-    ``X = Counter()`` / ``ErrorCounters()`` / ``defaultdict(int|float)``,
-    or an UPPER_CASE dict/list global whose name says counter/stats."""
-    tree = ast.parse(open(path).read())
-    rel = os.path.relpath(path, os.path.dirname(PKG))
-    issues = []
-    for node in tree.body:
-        if isinstance(node, ast.Assign):
-            targets, value = node.targets, node.value
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets, value = [node.target], node.value
-        else:
-            continue
-        names = [t.id for t in targets if isinstance(t, ast.Name)]
-        if not names:
-            continue
-        smells = None
-        if isinstance(value, ast.Call):
-            fn = value.func
-            callee = (
-                fn.attr if isinstance(fn, ast.Attribute)
-                else getattr(fn, "id", "")
-            )
-            if callee in _COUNTERISH_CALLS:
-                smells = f"{callee}(...)"
-        if smells is None and isinstance(value, (ast.Dict, ast.List)):
-            if any(
-                n.isupper() and n.lower().endswith(_COUNTERISH_NAMES)
-                for n in names
-            ):
-                smells = "counter-named global"
-        if smells is None:
-            continue
-        for n in names:
-            key = f"{rel}:{n}"
-            if key not in COUNTER_ALLOWLIST:
-                issues.append(
-                    f"{path}:{node.lineno}: module-level counter {n!r} "
-                    f"({smells}) — register it on the server's "
-                    "MetricsRegistry (predictionio_tpu/obs) instead"
-                )
-    return issues
-
-
-def test_no_adhoc_module_level_counters():
-    obs_dir = os.path.join(PKG, "obs")
-    issues = [
-        issue
-        for path in iter_modules()
-        if not path.startswith(obs_dir)
-        for issue in module_level_counters(path)
-    ]
+def test_no_unused_imports(hygiene_report):
+    issues = _by_rule(hygiene_report, "hygiene-unused-import")
     assert not issues, "\n".join(issues)
 
 
-# -- cache hygiene: one cache idiom, one invalidation story -------------------
-
-# Caching that predates the serving cache layer, grandfathered as
-# "path:name". These are jit-compilation caches keyed by static config —
-# they hold compiled XLA programs, not data, so event-driven invalidation
-# doesn't apply to them. Everything NEW found by this lint is a
-# regression: a per-module cache outside serving/ has no invalidation
-# hook (events can't reach it), no obs bridge (/metrics can't see it),
-# and no TTL backstop — serving/result_cache.py and
-# serving/event_cache.py exist so stale-answer bugs have one home.
-CACHE_ALLOWLIST = {
-    "predictionio_tpu/parallel/ring.py:_build_ring_fn",
-    "predictionio_tpu/parallel/ring.py:_build_ring_flash_fn",
-    "predictionio_tpu/parallel/ulysses.py:_build_ulysses_fn",
-    # per-response Date header memo, rebuilt every second; not a data cache
-    "predictionio_tpu/common/http.py:_DATE_CACHE",
-}
-
-_CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
+def test_no_adhoc_module_level_counters(hygiene_report):
+    issues = _by_rule(hygiene_report, "hygiene-module-counter")
+    assert not issues, "\n".join(issues)
 
 
-def _decorator_name(dec: ast.expr) -> str:
-    # @lru_cache, @functools.lru_cache, @lru_cache(maxsize=N) all resolve
-    # to the bare callee name
-    if isinstance(dec, ast.Call):
-        dec = dec.func
-    if isinstance(dec, ast.Attribute):
-        return dec.attr
-    return getattr(dec, "id", "")
-
-
-def adhoc_caches(path: str) -> list[str]:
-    """Module-level caching outside the serving cache layer: memoizing
-    decorators (``functools.lru_cache``/``cache``) and module-level
-    globals whose name says cache (``X_CACHE = {...}``, ``_cache = {}``).
-    Instance attributes are out of scope — they die with their owner."""
-    tree = ast.parse(open(path).read())
-    rel = os.path.relpath(path, os.path.dirname(PKG))
-    issues = []
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in node.decorator_list:
-                name = _decorator_name(dec)
-                if name in _CACHE_DECORATORS and name != "cached_property":
-                    key = f"{rel}:{node.name}"
-                    if key not in CACHE_ALLOWLIST:
-                        issues.append(
-                            f"{path}:{node.lineno}: @{name} on "
-                            f"{node.name!r} — per-module caches belong in "
-                            "predictionio_tpu/serving (result_cache/"
-                            "event_cache: invalidation + obs + TTL), not "
-                            "in ad-hoc memoizers"
-                        )
-    for node in tree.body:
-        if isinstance(node, ast.Assign):
-            targets = node.targets
-        elif isinstance(node, ast.AnnAssign):
-            targets = [node.target]
-        else:
-            continue
-        for t in targets:
-            if not isinstance(t, ast.Name):
-                continue
-            if not t.id.lower().rstrip("s").endswith("cache"):
-                continue
-            key = f"{rel}:{t.id}"
-            if key not in CACHE_ALLOWLIST:
-                issues.append(
-                    f"{path}:{node.lineno}: module-level cache global "
-                    f"{t.id!r} — use serving/result_cache.py or "
-                    "serving/event_cache.py (they carry invalidation, "
-                    "obs bridging, and a TTL backstop)"
-                )
-    return issues
-
-
-def test_no_adhoc_caches_outside_serving():
-    serving_dir = os.path.join(PKG, "serving")
-    issues = [
-        issue
-        for path in iter_modules()
-        if not path.startswith(serving_dir)
-        for issue in adhoc_caches(path)
-    ]
+def test_no_adhoc_caches_outside_serving(hygiene_report):
+    issues = _by_rule(hygiene_report, "hygiene-adhoc-cache")
     assert not issues, "\n".join(issues)

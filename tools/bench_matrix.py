@@ -171,6 +171,29 @@ def main() -> int:
         "p50_off_ms": obs.get("p50_off_ms"),
         "metric_series": obs.get("metric_series"),
     }
+    # static-analysis gate: perf numbers from a repo carrying hot-path or
+    # race hazards are not publishable — `pio analyze` must report zero
+    # errors for the matrix to count
+    ana = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.tools.cli",
+         "analyze", "--format", "json", "--root", REPO],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    try:
+        report = json.loads(ana.stdout)
+        counts = report.get("counts", {})
+        artifact["analysis"] = {
+            "errors": counts.get("error"),
+            "warnings": counts.get("warning"),
+            "baselined": report.get("baselined"),
+            "gate_pass": counts.get("error") == 0,
+        }
+    except (json.JSONDecodeError, AttributeError):
+        artifact["analysis"] = {
+            "errors": None, "warnings": None, "baselined": None,
+            "gate_pass": False,
+            "stderr": (ana.stderr or "")[-500:],
+        }
     with open(final, "w") as f:
         json.dump(artifact, f, indent=1)
     print(json.dumps({
@@ -182,6 +205,7 @@ def main() -> int:
         "ingest": artifact["ingest"],
         "durability": artifact["durability"],
         "observability": artifact["observability"],
+        "analysis": artifact["analysis"],
     }))
     return 0 if all_tpu else 1
 
