@@ -31,6 +31,7 @@ import dataclasses
 import logging
 import os
 import threading
+import time
 from functools import partial
 from typing import Optional
 
@@ -608,6 +609,40 @@ def _make_step(mesh, ub: _Blocks, ib: _Blocks, cfg: ALSConfig):
     return step
 
 
+def _train_devprof(cfg: "ALSConfig", n_ratings: int, n_users: int,
+                   n_items: int, n_devices: int):
+    """Cost-annotate the process-global train accountant for this run.
+
+    Returns ``(accountant, dispatch_key)``; each training step records
+    its blocked wall against the analytic per-device iteration cost, so
+    ``pio train`` exposes the same utilization families serving does
+    (read via :func:`obs.devprof.train_snapshot`).
+    """
+    from predictionio_tpu.obs import devprof
+
+    acc = devprof.train_recorder(platform=jax.default_backend())
+    flops, nbytes = devprof.als_train_cost(
+        n_ratings, n_users, n_items, cfg.rank, cfg.compute_dtype
+    )
+    n = max(1, int(n_devices))
+    key = f"als_iter_r{cfg.rank}"
+    acc.set_cost(key, flops / n, nbytes / n, source="analytic")
+    return acc, key
+
+
+def _log_step_utilization(acc, it: int, total: int) -> None:
+    snap = acc.snapshot()
+    if not snap:
+        return
+    mfu = snap.get("mfu")
+    logger.info(
+        "als iter %d/%d utilization: busy=%.3f gflops=%.2f hbm_gbps=%.2f"
+        " mfu=%s",
+        it + 1, total, snap["busy_fraction"], snap["flops_per_s"] / 1e9,
+        snap["hbm_gbps"], "n/a" if mfu is None else f"{mfu:.6f}",
+    )
+
+
 # ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
@@ -737,8 +772,20 @@ def train_als(
             U = jax.device_put(np.asarray(state["U"]), sharding)
             V = jax.device_put(np.asarray(state["V"]), sharding)
 
+    # per-step utilization: the step is blocked to completion inside the
+    # timing (steps are data-dependent, so there is no cross-step device
+    # overlap to lose — the only cost is one dispatch round-trip per iter)
+    util_acc, util_key = _train_devprof(
+        cfg, len(rating), n_users, n_items, n_shards
+    )
     for it in range(start_iter, cfg.iterations):
+        t_step = time.perf_counter()
         U, V = step(U, V, u_blocks, i_blocks)
+        # measured fence: the step wall feeds the utilization accountant;
+        # steps are data-dependent, so no cross-step overlap is lost
+        jax.block_until_ready(U)  # pio: ignore[hotpath-block-sync]
+        util_acc.record(util_key, time.perf_counter() - t_step)
+        _log_step_utilization(util_acc, it, cfg.iterations)
         if manager is not None and save_due(
             it + 1, cfg.checkpoint_interval, cfg.iterations
         ):
@@ -1004,8 +1051,17 @@ def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
             U = place_rows(np.asarray(state["U"]))
             V = place_rows(np.asarray(state["V"]))
 
+    util_acc, util_key = _train_devprof(
+        cfg, int(sh.user_counts.sum()), sh.n_users, sh.n_items, n_shards
+    )
     for it in range(start_iter, cfg.iterations):
+        t_step = time.perf_counter()
         U, V = step(U, V, u_blocks, i_blocks)
+        # measured fence: the step wall feeds the utilization accountant;
+        # steps are data-dependent, so no cross-step overlap is lost
+        jax.block_until_ready(U)  # pio: ignore[hotpath-block-sync]
+        util_acc.record(util_key, time.perf_counter() - t_step)
+        _log_step_utilization(util_acc, it, cfg.iterations)
         if manager is not None:
             from predictionio_tpu.core.checkpoint import save_due
 

@@ -20,7 +20,8 @@ substrate:
 Knobs (env): ``PIO_TELEMETRY=0`` disables installation, ``PIO_TRACE_SAMPLE``
 sets the head-sampling rate (default 0.1), ``PIO_TRACE_RING`` the ring size
 (default 256), ``PIO_METRICS_MAX_SERIES`` the per-metric label-cardinality
-cap (default 512).
+cap (default 512), ``PIO_SLOW_TRACE_QUANTILE`` / ``PIO_SLOW_TRACE_RING``
+the flight recorder's tail-sampling quantile and ring (0.99 / 64).
 """
 
 from __future__ import annotations
@@ -111,6 +112,18 @@ class Telemetry:
             "Finished traces currently held in the in-memory ring.",
             lambda: float(len(self.tracer.ring)),
         )
+        reg.gauge_fn(
+            "pio_slow_trace_retained",
+            "Slow-request exemplars retained by the flight recorder "
+            "since start (tail sampling above the rolling quantile).",
+            lambda: float(self.tracer.slow_retained),
+        )
+        reg.gauge_fn(
+            "pio_slow_trace_threshold_seconds",
+            "Current rolling-quantile wall-time threshold for slow-trace "
+            "retention (NaN until the reservoir warms up).",
+            lambda: float(self.tracer.slow_threshold_s() or float("nan")),
+        )
 
     # -- HTTP request-loop hooks (called from common/http.py) ---------------
     def observe_http(
@@ -152,6 +165,26 @@ class Telemetry:
                     "sampleRate": self.tracer.sample_rate,
                     "ringSize": self.tracer.ring_max,
                     "traces": self.tracer.recent(limit),
+                },
+            )
+
+        @service.route("GET", r"/trace/slow\.json")
+        def _slow_traces(req):
+            from predictionio_tpu.common.http import json_response
+
+            limit = int(req.params.get("limit") or 0) or None
+            thr = self.tracer.slow_threshold_s()
+            return json_response(
+                200,
+                {
+                    "service": self.service_name,
+                    "quantile": self.tracer.slow_quantile,
+                    "ringSize": self.tracer.slow_ring_max,
+                    "thresholdMs": (
+                        None if thr is None else round(thr * 1e3, 4)
+                    ),
+                    "retained": self.tracer.slow_retained,
+                    "traces": self.tracer.slow_recent(limit),
                 },
             )
 

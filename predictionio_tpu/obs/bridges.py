@@ -205,6 +205,81 @@ def bridge_fastpath(
     registry.register_collector(collect)
 
 
+# -- serving: device-utilization accountant ----------------------------------
+
+def bridge_devprof(
+    registry: MetricsRegistry,
+    snapshot_fn: Callable[[], Optional[dict]],
+    generation_fn: Optional[Callable[[], int]] = None,
+) -> None:
+    """A :class:`~predictionio_tpu.obs.devprof.DeviceUtilization`
+    snapshot → the live pio_device_* utilization gauges.
+
+    ``generation_fn`` labels every sample with the model generation the
+    live scorer belongs to (the accountant is rebuilt with the scorer on
+    reload, so one accountant == one generation). mfu / hbm_util are
+    omitted when the platform has no peak-table entry — absent beats a
+    fabricated zero.
+    """
+
+    def collect():
+        s = snapshot_fn()
+        if not s:
+            return []
+        gen = str(generation_fn() if generation_fn is not None else 0)
+        lbl = (("generation", gen),)
+        fams = [
+            _fam(
+                "pio_device_busy_fraction", "gauge",
+                "Fraction of the rolling window the device spent inside "
+                "cost-annotated dispatches.",
+                [("", lbl, _num(s.get("busy_fraction")))],
+            ),
+            _fam(
+                "pio_device_flops_per_s", "gauge",
+                "Achieved FLOP/s over the rolling window (per-dispatch "
+                "cost from XLA cost_analysis or the analytic model).",
+                [("", lbl, _num(s.get("flops_per_s")))],
+            ),
+            _fam(
+                "pio_device_hbm_gbps", "gauge",
+                "Achieved HBM GB/s over the rolling window.",
+                [("", lbl, _num(s.get("hbm_gbps")))],
+            ),
+            _fam(
+                "pio_device_dispatches_total", "counter",
+                "Cost-annotated device dispatches since this accountant "
+                "(== model generation) went live.",
+                [("", lbl, _num(s.get("dispatches_total")))],
+            ),
+            _fam(
+                "pio_device_busy_seconds", "gauge",
+                "Device seconds spent in dispatches within the window.",
+                [("", lbl, _num(s.get("busy_s")))],
+            ),
+        ]
+        if s.get("mfu") is not None:
+            fams.append(
+                _fam(
+                    "pio_device_mfu", "gauge",
+                    "Model FLOP utilization: achieved FLOP/s over the "
+                    "per-chip peak (devprof.PEAKS).",
+                    [("", lbl, _num(s.get("mfu")))],
+                )
+            )
+        if s.get("hbm_util") is not None:
+            fams.append(
+                _fam(
+                    "pio_device_hbm_util", "gauge",
+                    "Achieved HBM bandwidth over the per-chip peak.",
+                    [("", lbl, _num(s.get("hbm_util")))],
+                )
+            )
+        return fams
+
+    registry.register_collector(collect)
+
+
 # -- serving: result cache + event cache (one cache idiom, one surface) ------
 
 def bridge_result_cache(

@@ -1,0 +1,308 @@
+"""Device-utilization accounting: cost models, peaks, live rates, capture.
+
+ROADMAP item 4 is gated on measurement — "MFU is effectively unmeasured" —
+and before this module the only utilization numbers lived in offline bench
+runs (``bench.py``).  This module makes utilization a RUNTIME fact:
+
+* **One cost model, one peak table.**  The analytic ALS iteration cost and
+  the per-chip peak table previously private to ``bench.py`` live here, so
+  the bench, the training loop, and the serving fastpath all divide by the
+  same denominators.  ``PEAKS`` carries a CPU entry: fallback runs report a
+  real (if rough) MFU instead of null, which keeps regression ratios
+  comparable run-over-run on the same host.
+* **Rolling-window dispatch accountant** (:class:`DeviceUtilization`).
+  The serving fastpath annotates every AOT bucket with FLOPs/bytes from
+  ``compiled.cost_analysis()`` (analytic fallback when the compiler
+  declines) and records each dispatch's device wall here; the ALS train
+  loop does the same per training step.  :meth:`DeviceUtilization.snapshot`
+  reduces the window into achieved FLOP/s, HBM GB/s, MFU, HBM utilization,
+  and device busy fraction — the live ``pio_device_*`` gauge families.
+* **On-demand profile capture** (:func:`capture_profile`): a bounded
+  ``jax.profiler`` window written under the basedir, driven by the query
+  server's ``POST /debug/profile`` and the ``pio profile`` CLI.
+
+Knobs: ``PIO_DEVPROF_WINDOW`` — rolling-window length in seconds for the
+live gauges (default 60).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+__all__ = [
+    "PEAKS",
+    "peak_for",
+    "als_train_cost",
+    "train_utilization",
+    "score_cost",
+    "DeviceUtilization",
+    "train_recorder",
+    "train_snapshot",
+    "capture_profile",
+]
+
+# Per-chip peaks for utilization accounting. v5e: 197 TFLOP/s bf16 MXU,
+# 819 GB/s HBM (public spec). mfu is defined against the bf16 peak — the
+# number the hardware markets — so a 10× utilization regression is visible
+# regardless of the dtype in use. The CPU row is an order-of-magnitude
+# stand-in for a modern server socket (~1 TFLOP/s f32 SIMD, ~100 GB/s
+# DRAM): good for run-over-run ratios on the same fallback host, not for
+# publishing as an absolute hardware number. Platforms not listed report
+# null utilization.
+PEAKS = {
+    "tpu": {"flops": 197e12, "hbm_gbps": 819e9},
+    "cpu": {"flops": 1e12, "hbm_gbps": 100e9},
+}
+
+DEFAULT_WINDOW_S = 60.0
+
+
+def peak_for(platform: Optional[str]) -> Optional[dict]:
+    """Per-chip peak {flops, hbm_gbps} for a jax platform name, or None."""
+    if platform is None:
+        return None
+    return PEAKS.get(str(platform).lower())
+
+
+def als_train_cost(
+    n_ratings: int, n_users: int, n_items: int, rank: int, dtype: str = "f32"
+) -> tuple[float, float]:
+    """Analytic (FLOPs, HBM bytes) of ONE dense-solver ALS iteration.
+
+    Cost model (both half-steps of one iteration, dense solver):
+      FLOPs: per rating 2·(2k² + 4k) madds (outer product + rhs accumulate,
+      both sides) + per entity 2·(k³/3) Cholesky factor+solve madds.
+      HBM bytes: per rating, both sides: k·s gather read + 12 B of
+      idx/rat/msk + k·s of A-tile write amortized; per entity k·4 factor
+      write + opposite-factor read once per half-step.
+    A model, not a measurement — good for regression visibility, not for
+    publishing as achieved hardware counters.
+    """
+    k = rank
+    s = 2 if dtype == "bf16" else 4  # bytes per factor element
+    ents = n_users + n_items
+    flops_per_iter = n_ratings * 2 * (2 * k * k + 4 * k) * 2 + ents * (
+        2 * k**3 / 3
+    )
+    bytes_per_iter = (
+        n_ratings * 2 * (k * s + 12)  # gather + idx/rat/msk streams
+        + ents * k * (4 + s)  # factor write (f32) + opposite read
+    )
+    return float(flops_per_iter), float(bytes_per_iter)
+
+
+def train_utilization(
+    n_ratings, n_users, n_items, rank, iterations, dtype, dt, n_chips,
+    platform,
+) -> dict:
+    """Analytic achieved-FLOP/s + HBM-GB/s from workload dims and wall time.
+
+    The shape ``bench.py`` publishes in its ``utilization`` block; the
+    cost model is :func:`als_train_cost`, the denominators :data:`PEAKS`.
+    """
+    flops_per_iter, bytes_per_iter = als_train_cost(
+        n_ratings, n_users, n_items, rank, dtype
+    )
+    flops = flops_per_iter * iterations / dt / n_chips
+    gbps = bytes_per_iter * iterations / dt / n_chips
+    peak = peak_for(platform)
+    return {
+        "model_flops_per_sec_per_chip": round(flops / 1e9, 2),  # GFLOP/s
+        "model_hbm_gbps_per_chip": round(gbps / 1e9, 2),
+        "mfu": round(flops / peak["flops"], 6) if peak else None,
+        "hbm_util": round(gbps / peak["hbm_gbps"], 6) if peak else None,
+    }
+
+
+def score_cost(
+    batch: int, n_items: int, rank: int, dtype: str = "f32"
+) -> tuple[float, float]:
+    """Analytic (FLOPs, HBM bytes) of one bucketed score+top-k dispatch.
+
+    Fallback for buckets where ``compiled.cost_analysis()`` declines:
+    the (B, k) × (k, I) score matmul dominates FLOPs (plus ~8 ops/score
+    for masking and the top-k compare network); bytes are the factor
+    reads, the materialized score matrix round-trip, and the (B, k)
+    result write.
+    """
+    b, i, k = float(batch), float(n_items), float(rank)
+    s = 2.0 if dtype == "bf16" else 4.0
+    flops = b * i * (2.0 * k + 8.0)
+    nbytes = i * k * s + b * k * s + 2.0 * b * i * s + b * k * 8.0
+    return flops, nbytes
+
+
+class DeviceUtilization:
+    """Rolling-window accountant for cost-annotated device dispatches.
+
+    The owner annotates each dispatch class (serving bucket, train step)
+    with its FLOPs/bytes once via :meth:`set_cost`, then calls
+    :meth:`record` with the measured device wall per dispatch.  Records
+    older than the window age out; :meth:`snapshot` reduces what's left
+    into achieved rates and utilization against the platform peak.  All
+    methods are thread-safe; ``record`` is O(1) amortized.
+    """
+
+    def __init__(
+        self,
+        platform: Optional[str] = None,
+        window_s: Optional[float] = None,
+    ):
+        if window_s is None:
+            window_s = float(
+                os.environ.get("PIO_DEVPROF_WINDOW", DEFAULT_WINDOW_S)
+            )
+        self.window_s = max(1.0, float(window_s))
+        self.platform = platform
+        self._costs: dict = {}  # dispatch key → (flops, bytes)
+        self._cost_source: dict = {}  # dispatch key → "xla" | "analytic"
+        # (t_recorded, device_seconds, flops, bytes) per dispatch
+        self._records: deque = deque()
+        self._lock = threading.Lock()
+        self._t_created = time.monotonic()
+        self.dispatches = 0  # lifetime, never pruned
+
+    def set_cost(
+        self, key, flops: Optional[float], nbytes: Optional[float],
+        source: str = "xla",
+    ) -> None:
+        """Annotate dispatch class ``key`` with per-dispatch FLOPs/bytes."""
+        with self._lock:
+            self._costs[key] = (
+                float(flops) if flops else 0.0,
+                float(nbytes) if nbytes else 0.0,
+            )
+            self._cost_source[key] = source
+
+    def costs(self) -> dict:
+        with self._lock:
+            return {
+                k: {
+                    "flops": f, "bytes": by,
+                    "source": self._cost_source.get(k),
+                }
+                for k, (f, by) in self._costs.items()
+            }
+
+    def record(self, key, seconds: float) -> None:
+        """Charge one dispatch of class ``key`` with measured device wall."""
+        if seconds < 0:
+            seconds = 0.0
+        now = time.monotonic()
+        with self._lock:
+            flops, nbytes = self._costs.get(key, (0.0, 0.0))
+            self._records.append((now, float(seconds), flops, nbytes))
+            self.dispatches += 1
+            self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._records and self._records[0][0] < cutoff:
+            self._records.popleft()
+
+    def snapshot(self) -> Optional[dict]:
+        """Windowed rates + utilization; None before the first dispatch.
+
+        ``busy_fraction`` (and the rates) divide by the OBSERVED span —
+        window length once the accountant has lived that long, its age
+        before that — so a freshly warmed server reports its true duty
+        cycle instead of a number diluted by a mostly-empty window.
+        """
+        now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            if not self.dispatches:
+                return None
+            elapsed = min(self.window_s, max(1e-9, now - self._t_created))
+            busy = sum(r[1] for r in self._records)
+            flops = sum(r[2] for r in self._records)
+            nbytes = sum(r[3] for r in self._records)
+            n = len(self._records)
+        flops_per_s = flops / elapsed
+        gbps = nbytes / elapsed
+        peak = peak_for(self.platform)
+        return {
+            "platform": self.platform,
+            "window_s": self.window_s,
+            "elapsed_s": round(elapsed, 3),
+            "dispatches_window": n,
+            "dispatches_total": self.dispatches,
+            "busy_s": round(busy, 6),
+            "busy_fraction": round(min(1.0, busy / elapsed), 6),
+            "flops_per_s": round(flops_per_s, 2),
+            # 6 decimals: a rank-2 toy model on CPU still reads non-zero
+            "hbm_gbps": round(gbps / 1e9, 6),
+            "mfu": round(flops_per_s / peak["flops"], 9) if peak else None,
+            "hbm_util": round(gbps / peak["hbm_gbps"], 9) if peak else None,
+        }
+
+
+def default_platform() -> Optional[str]:
+    """The jax default backend's platform name (lazy import; None if jax
+    is unavailable or not yet initializable)."""
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return None
+
+
+# -- train-side recorder ------------------------------------------------------
+# `pio train` has no HTTP server to scrape, so the train loop records into
+# a process-global accountant; the CLI and tests read the snapshot, and the
+# loop logs a utilization line per step so a long train is visible live.
+
+_train_lock = threading.Lock()
+_train_acc: Optional[DeviceUtilization] = None
+
+
+def train_recorder(platform: Optional[str] = None) -> DeviceUtilization:
+    """The process-global training accountant (created on first use)."""
+    global _train_acc
+    with _train_lock:
+        if _train_acc is None or (
+            platform is not None and _train_acc.platform != platform
+        ):
+            _train_acc = DeviceUtilization(platform=platform)
+        return _train_acc
+
+
+def train_snapshot() -> Optional[dict]:
+    with _train_lock:
+        acc = _train_acc
+    return acc.snapshot() if acc is not None else None
+
+
+# -- on-demand profile capture ------------------------------------------------
+
+
+def capture_profile(ms: int, out_dir: Optional[str] = None) -> str:
+    """Run ``jax.profiler`` for a bounded window; return the trace dir.
+
+    Blocks the calling thread for ``ms`` milliseconds while the rest of
+    the process keeps serving — exactly what the query server's
+    ``POST /debug/profile`` wants. Traces land under
+    ``<basedir>/profiles/<stamp>`` unless ``out_dir`` overrides.
+    """
+    import jax
+
+    from predictionio_tpu.utils.fs import pio_base_dir
+
+    ms = max(1, int(ms))
+    if out_dir is None:
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        out_dir = os.path.join(
+            pio_base_dir(), "profiles", f"{stamp}-{os.getpid()}"
+        )
+    os.makedirs(out_dir, exist_ok=True)
+    jax.profiler.start_trace(out_dir)
+    try:
+        time.sleep(ms / 1e3)
+    finally:
+        jax.profiler.stop_trace()
+    return out_dir

@@ -43,9 +43,12 @@ def log_buckets(start: float, factor: float, count: int) -> tuple:
     return tuple(start * factor ** i for i in range(count))
 
 
-# 0.5 ms .. ~16 s in octaves: wide enough for an HTTP request that waits
-# on a cold storage call, fine enough to see a 2-vs-3 ms serving shift
-DEFAULT_LATENCY_BUCKETS = log_buckets(0.0005, 2.0, 16)
+# ~8 µs .. ~16 s in octaves: wide enough for an HTTP request that waits
+# on a cold storage call, fine enough to see a 2-vs-3 ms serving shift —
+# and a sub-millisecond `device_compute` dispatch no longer collapses
+# into the bottom rung (the old 0.5 ms floor put ALL device times there).
+# The rungs above 0.5 ms are unchanged from the original ladder.
+DEFAULT_LATENCY_BUCKETS = log_buckets(0.0005 / 2**6, 2.0, 22)
 
 
 def format_value(v: float) -> str:

@@ -20,6 +20,10 @@ Propagation contract (documented in docs/observability.md):
   echoes the id back.
 * Requests without the header are head-sampled at ``PIO_TRACE_SAMPLE``
   (deterministic every-Nth admission — no RNG in the hot path).
+* Finished traces are additionally TAIL-sampled: walls above a rolling
+  quantile (``PIO_SLOW_TRACE_QUANTILE``) land in a second bounded ring
+  (``PIO_SLOW_TRACE_RING``) at ``GET /trace/slow.json`` — the flight
+  recorder that explains the p99 instead of merely counting it.
 
 Cross-thread attribution: the micro-batcher executes ONE batch for many
 requests, so the worker thread installs every batch member's trace as
@@ -42,6 +46,17 @@ TRACE_HEADER = "X-Request-Id"
 
 DEFAULT_SAMPLE_RATE = 0.1
 DEFAULT_RING_SIZE = 256
+# flight recorder (tail sampling): retain traces whose wall exceeds this
+# rolling quantile of recent request walls, in a ring of this size
+DEFAULT_SLOW_QUANTILE = 0.99
+DEFAULT_SLOW_RING_SIZE = 64
+# wall-time reservoir backing the rolling quantile; threshold is
+# recomputed every _SLOW_RECOMPUTE records so the hot path stays O(1)
+_SLOW_RESERVOIR = 512
+_SLOW_RECOMPUTE = 16
+# tail sampling stays off until the reservoir has seen this many walls —
+# with two data points "the 99th percentile" would just be the max
+_SLOW_MIN_SAMPLES = 16
 
 
 class Trace:
@@ -77,6 +92,12 @@ class Trace:
             yield
         finally:
             self.add_stage(name, time.perf_counter() - t0)
+
+    def annotate(self, **kv) -> None:
+        """Attach request context (bucket, batch size, cache disposition…)
+        to the trace — the flight recorder's "why was this slow" fields."""
+        with self._lock:
+            self.meta.update(kv)
 
     def finish(self, status: Optional[int] = None) -> None:
         wall = time.perf_counter() - self._t0
@@ -162,12 +183,22 @@ def new_request_id() -> str:
 
 
 class Tracer:
-    """Head sampler + bounded ring of finished traces."""
+    """Head sampler + bounded ring of finished traces + flight recorder.
+
+    The flight recorder is TAIL-based: after a sampled trace finishes,
+    its wall time is compared against a rolling quantile
+    (``PIO_SLOW_TRACE_QUANTILE``) of recent walls, and outliers are
+    retained — with their full stage breakdown and meta — in a second
+    bounded ring (``PIO_SLOW_TRACE_RING``) served at
+    ``GET /trace/slow.json``.  The p99 is explained, not just counted.
+    """
 
     def __init__(
         self,
         sample_rate: Optional[float] = None,
         ring_size: Optional[int] = None,
+        slow_quantile: Optional[float] = None,
+        slow_ring_size: Optional[int] = None,
     ):
         if sample_rate is None:
             sample_rate = float(
@@ -177,6 +208,18 @@ class Tracer:
             ring_size = int(
                 os.environ.get("PIO_TRACE_RING", DEFAULT_RING_SIZE)
             )
+        if slow_quantile is None:
+            slow_quantile = float(
+                os.environ.get(
+                    "PIO_SLOW_TRACE_QUANTILE", DEFAULT_SLOW_QUANTILE
+                )
+            )
+        if slow_ring_size is None:
+            slow_ring_size = int(
+                os.environ.get(
+                    "PIO_SLOW_TRACE_RING", DEFAULT_SLOW_RING_SIZE
+                )
+            )
         self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
         self.ring_max = max(1, int(ring_size))
         self.ring: deque = deque(maxlen=self.ring_max)
@@ -184,6 +227,14 @@ class Tracer:
         self.sampled = 0
         self._acc = 0.0
         self._lock = threading.Lock()
+        # flight recorder state (slow_quantile <= 0 disables retention)
+        self.slow_quantile = min(1.0, float(slow_quantile))
+        self.slow_ring_max = max(1, int(slow_ring_size))
+        self.slow_ring: deque = deque(maxlen=self.slow_ring_max)
+        self.slow_retained = 0
+        self._walls: deque = deque(maxlen=_SLOW_RESERVOIR)
+        self._slow_threshold: Optional[float] = None
+        self._since_recompute = 0
 
     def begin(
         self,
@@ -209,9 +260,51 @@ class Tracer:
 
     def record(self, trace: Trace) -> None:
         self.ring.append(trace)  # deque append is atomic
+        wall = trace.wall_s
+        if wall is None or self.slow_quantile <= 0.0:
+            return
+        with self._lock:
+            # threshold from the reservoir BEFORE admitting this wall, so
+            # a request is never judged against a sample that includes it
+            thr = self._slow_threshold
+            retain = (
+                thr is not None
+                and len(self._walls) >= _SLOW_MIN_SAMPLES
+                and wall > thr
+            )
+            self._walls.append(wall)
+            self._since_recompute += 1
+            if (
+                self._slow_threshold is None
+                or self._since_recompute >= _SLOW_RECOMPUTE
+            ):
+                self._since_recompute = 0
+                ordered = sorted(self._walls)
+                i = min(
+                    len(ordered) - 1,
+                    int(self.slow_quantile * len(ordered)),
+                )
+                self._slow_threshold = ordered[i]
+            if retain:
+                self.slow_retained += 1
+                self.slow_ring.append(trace)
+
+    def slow_threshold_s(self) -> Optional[float]:
+        """Current rolling-quantile wall threshold (None until warmed)."""
+        with self._lock:
+            if len(self._walls) < _SLOW_MIN_SAMPLES:
+                return None
+            return self._slow_threshold
 
     def recent(self, limit: Optional[int] = None) -> list:
         traces = list(self.ring)
+        if limit:
+            traces = traces[-limit:]
+        return [t.to_dict() for t in reversed(traces)]
+
+    def slow_recent(self, limit: Optional[int] = None) -> list:
+        """Retained slow-request exemplars, newest first."""
+        traces = list(self.slow_ring)
         if limit:
             traces = traces[-limit:]
         return [t.to_dict() for t in reversed(traces)]

@@ -181,6 +181,11 @@ class MicroBatcher:
             if leader is not None:
                 with self._stats_lock:
                     self._n_coalesced += 1
+                if p.trace is not None:
+                    # flight-recorder context: this request rode another
+                    # identical query's device slot — its trace must NOT
+                    # carry device stages (charged once, to the leader)
+                    p.trace.annotate(coalesce="follower")
                 if not p.event.wait(eff.remaining_s()):
                     # the leader's batch will still resolve this pending
                     # (harmlessly, after we've gone) — nothing dangles
@@ -402,6 +407,12 @@ class MicroBatcher:
                 # time between enqueue and dispatch: the coalescing window
                 # the request paid for (≈0 on the inline bypass)
                 p.trace.add_stage("queue_wait", t_run - p.t_enq)
+                # flight-recorder context: how this request's batch formed
+                p.trace.annotate(
+                    batch=len(batch),
+                    dispatch="inline" if inline else "window",
+                    **({"coalesce": "leader"} if p.key is not None else {}),
+                )
         results: Optional[list] = None
         run_error: Optional[BaseException] = None
         try:

@@ -51,6 +51,7 @@ from predictionio_tpu.core.workflow import (
 )
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.obs import bridges as _bridges
+from predictionio_tpu.obs import devprof as _devprof
 from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.serving.result_cache import (
@@ -236,6 +237,11 @@ class QueryServer:
         # cached answers from the previous generation can never validate
         # even if clear() were to race a concurrent put
         self._serving_gen = 0
+        # on-demand profiler (POST /debug/profile): one capture at a time
+        # (jax.profiler is process-global), bounded window, counted
+        self._profile_lock = threading.Lock()
+        self._profile_captures = 0
+        self._profile_last_unix = 0.0
         self._register_routes()
         self.reload()
         self._batcher = None
@@ -472,6 +478,14 @@ class QueryServer:
         if self._batcher is not None:
             _bridges.bridge_batcher(reg, self._batcher.stats)
         _bridges.bridge_fastpath(reg, self._fastpath_stats)
+        # live device utilization: the scorer's cost-annotated dispatch
+        # accountant, labeled with the generation it serves (the scorer —
+        # and its accountant — are rebuilt on every successful reload)
+        _bridges.bridge_devprof(
+            reg,
+            lambda: (self._fastpath_stats() or {}).get("devprof"),
+            lambda: self._serving_gen,
+        )
         if self._result_cache is not None:
             _bridges.bridge_result_cache(reg, self._result_cache.stats)
         reg.gauge_fn(
@@ -520,6 +534,14 @@ class QueryServer:
                 F("pio_draining", "gauge",
                   "1 while the server is draining toward shutdown.",
                   [("", (), 1.0 if self._draining else 0.0)]),
+                F("pio_profile_captures_total", "counter",
+                  "On-demand jax.profiler captures served by "
+                  "POST /debug/profile.",
+                  [("", (), float(self._profile_captures))]),
+                F("pio_profile_last_capture_unix", "gauge",
+                  "Wall-clock time of the most recent profile capture "
+                  "(0 when none has run).",
+                  [("", (), float(self._profile_last_unix))]),
             ]
 
         reg.register_collector(_serving_families)
@@ -599,6 +621,18 @@ class QueryServer:
                 # no supplemented form exists on a hit; plugins and
                 # feedback see the bound query, as on the degraded path
                 supplemented = query
+        # flight-recorder context: which generation answered and whether
+        # the device was skipped (a cache hit never dispatches — its trace
+        # must carry no device stages)
+        for t in _tracing.active_traces():
+            t.annotate(
+                generation=self._serving_gen,
+                **(
+                    {"cache": "hit" if cache_hit else "miss"}
+                    if cache is not None
+                    else {}
+                ),
+            )
         if not cache_hit:
             try:
                 if deadline is not None and deadline.expired():
@@ -908,6 +942,45 @@ class QueryServer:
 
             threading.Thread(target=_stop, daemon=True).start()
             return json_response(200, {"message": "Shutting down."})
+
+        @svc.route("POST", r"/debug/profile")
+        def profile_route(req: Request):
+            # guarded, bounded, single-flight: jax.profiler is process-
+            # global, so concurrent captures are refused (409) rather
+            # than interleaved; the window is capped so a fat-fingered
+            # ms can't hold the trace machinery open for minutes
+            if os.environ.get("PIO_PROFILE_ENDPOINT", "1") == "0":
+                return json_response(
+                    403,
+                    {"message": "profile endpoint disabled "
+                     "(PIO_PROFILE_ENDPOINT=0)"},
+                )
+            try:
+                ms = int(req.params.get("ms") or 500)
+            except (TypeError, ValueError):
+                return json_response(
+                    400, {"message": "ms must be an integer"}
+                )
+            ms = max(1, min(ms, 10_000))
+            if not self._profile_lock.acquire(blocking=False):
+                return json_response(
+                    409, {"message": "a profile capture is already running"}
+                )
+            try:
+                path = _devprof.capture_profile(ms)
+            except Exception as e:
+                self._rl_log.exception(
+                    "profile", "profile capture failed"
+                )
+                return json_response(
+                    500, {"message": f"profile capture failed: {e}"}
+                )
+            finally:
+                self._profile_lock.release()
+            with self._lock:
+                self._profile_captures += 1
+                self._profile_last_unix = time.time()
+            return json_response(200, {"path": path, "ms": ms})
 
         @svc.route("GET", r"/plugins\.json")
         def plugins_route(req: Request):

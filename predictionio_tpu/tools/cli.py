@@ -663,6 +663,93 @@ def cmd_loadtest(args) -> int:
     return 0 if result["errors"] == 0 else 1
 
 
+def cmd_profile(args) -> int:
+    """``pio profile``: capture a device profile off a live query server
+    while driving load through the capture window, then print the
+    utilization picture (MFU / HBM / busy fraction) next to the client
+    quantiles.  The capture runs in a background thread so the loadtest
+    traffic is what the profiler sees; size ``--requests`` so the run
+    outlasts ``--ms`` or the tail of the window profiles an idle server.
+    """
+    import http.client
+    import threading
+
+    from predictionio_tpu.tools.loadtest import (
+        run_loadtest, scrape_metrics, summarize_metrics,
+    )
+
+    url = f"http://{args.ip}:{args.port}"
+    capture: dict = {}
+
+    def _capture() -> None:
+        conn = http.client.HTTPConnection(
+            args.ip, args.port, timeout=args.ms / 1e3 + 30.0
+        )
+        try:
+            conn.request("POST", f"/debug/profile?ms={args.ms}")
+            resp = conn.getresponse()
+            body = resp.read().decode("utf-8", "replace")
+            if resp.status == 200:
+                capture.update(json.loads(body))
+            else:
+                capture["error"] = f"HTTP {resp.status}: {body[:200]}"
+        except Exception as e:
+            capture["error"] = str(e)
+        finally:
+            conn.close()
+
+    t = threading.Thread(target=_capture, name="pio-profile-capture")
+    t.start()
+    result = run_loadtest(
+        url=url,
+        query=json.loads(args.query),
+        requests=args.requests,
+        concurrency=args.concurrency,
+    )
+    t.join()
+    try:
+        metrics = summarize_metrics(scrape_metrics(url))
+    except Exception as e:
+        metrics = {"error": str(e)}
+
+    if capture.get("path"):
+        print(f"[INFO] profile trace ({args.ms} ms): {capture['path']}")
+    else:
+        print(f"[WARN] profile capture failed: {capture.get('error')}")
+    print(
+        f"[INFO] loadtest: ok={result['ok']} errors={result['errors']} "
+        f"qps={result['qps']} p50={result['p50Ms']}ms p99={result['p99Ms']}ms"
+    )
+    busy = metrics.get("deviceBusyFraction")
+    if busy is None:
+        print("[WARN] no pio_device_* series on /metrics — the server has "
+              "not recorded a cost-annotated dispatch yet")
+    else:
+        mfu = metrics.get("deviceMfu")
+        hbm = metrics.get("deviceHbmUtil")
+        gflops = (metrics.get("deviceFlopsPerSec") or 0.0) / 1e9
+        print(
+            f"[INFO] device: busy={busy * 100:.2f}%  {gflops:.2f} GFLOP/s"
+            + (f"  MFU={mfu * 100:.4f}%" if mfu is not None else "")
+            + (f"  HBM={metrics.get('deviceHbmGbps'):.3f} GB/s "
+               f"({hbm * 100:.4f}% of peak)" if hbm is not None else "")
+        )
+        if mfu is not None and hbm is not None:
+            bound = "HBM-bandwidth" if hbm >= mfu else "compute"
+            print(f"[INFO] roofline: {bound}-bound at this batch mix "
+                  "(docs/perf_roofline.md has the peak table)")
+    if metrics.get("slowTraces") is not None:
+        print(f"[INFO] slow traces retained: {int(metrics['slowTraces'])} "
+              "(GET /trace/slow.json)")
+    print(json.dumps({
+        "profile": capture,
+        "loadtest": {k: result.get(k)
+                     for k in ("ok", "errors", "qps", "p50Ms", "p99Ms")},
+        "serverMetrics": metrics,
+    }))
+    return 0 if capture.get("path") and result["errors"] == 0 else 1
+
+
 def cmd_upgrade(args) -> int:
     # parity: Console "upgrade" verb — storage schemas here are
     # self-migrating (CREATE IF NOT EXISTS), so this is informational
@@ -986,6 +1073,26 @@ def build_parser() -> argparse.ArgumentParser:
         "failures are reported as afterStop, not errors",
     )
     sp.set_defaults(func=cmd_loadtest)
+
+    sp = sub.add_parser(
+        "profile",
+        help="capture a device profile off a live query server under "
+        "load and print the MFU/HBM/roofline summary",
+    )
+    sp.add_argument("--ip", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8000)
+    sp.add_argument("--query", default='{"user": "u1", "num": 10}')
+    sp.add_argument(
+        "--ms", type=int, default=500,
+        help="profiler capture window in milliseconds (server caps at 10s)",
+    )
+    sp.add_argument(
+        "--requests", type=int, default=500,
+        help="loadtest requests driven through the capture window — size "
+        "it so the traffic outlasts --ms",
+    )
+    sp.add_argument("--concurrency", type=int, default=8)
+    sp.set_defaults(func=cmd_profile)
 
     sub.add_parser("upgrade").set_defaults(func=cmd_upgrade)
 

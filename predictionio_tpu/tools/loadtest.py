@@ -106,6 +106,24 @@ def summarize_metrics(series: dict) -> dict:
     if total("pio_hotset_size"):
         out["hotsetHits"] = total("pio_hotset_lookups_total", outcome="hit")
         out["hotsetResident"] = total("pio_hotset_resident")
+    # device-utilization families (ISSUE 8) only exist once the scorer has
+    # recorded at least one cost-annotated dispatch; they carry a
+    # {generation} label, so take the max across label sets — after a
+    # reload the freshest generation is the one that describes this run
+    def latest(name: str):
+        vals = [v for (n, _labels), v in series.items() if n == name]
+        return max(vals) if vals else None
+
+    if latest("pio_device_busy_fraction") is not None:
+        out["deviceBusyFraction"] = latest("pio_device_busy_fraction")
+        out["deviceFlopsPerSec"] = latest("pio_device_flops_per_s")
+        out["deviceHbmGbps"] = latest("pio_device_hbm_gbps")
+        if latest("pio_device_mfu") is not None:
+            out["deviceMfu"] = latest("pio_device_mfu")
+        if latest("pio_device_hbm_util") is not None:
+            out["deviceHbmUtil"] = latest("pio_device_hbm_util")
+    if ("pio_slow_trace_retained", ()) in series:
+        out["slowTraces"] = total("pio_slow_trace_retained")
     for (name, labels), v in sorted(series.items()):
         if name.endswith("_breaker_state"):
             out.setdefault("breakerStates", {})[

@@ -421,6 +421,177 @@ class TestQueryServerTelemetry:
             qs.stop()
 
 
+class TestDeviceProfilerAndFlightRecorder:
+    """ISSUE 8 acceptance at the server level: live ``pio_device_*``
+    gauges under traffic, stage-annotated slow exemplars at
+    ``/trace/slow.json``, a readable ``POST /debug/profile`` capture, and
+    the charge-once invariant for result-cache hits."""
+
+    def _server(self, trained, **kw):
+        qs = QueryServer(
+            trained["engine"], storage=trained["storage"],
+            ctx=trained["ctx"], **kw,
+        )
+        port = qs.start("127.0.0.1", 0)
+        return qs, f"http://127.0.0.1:{port}"
+
+    def test_device_gauges_nonnull_nonzero_under_traffic(self, trained):
+        qs, base = self._server(trained, batching=True)
+        try:
+            for i in range(8):
+                _post(base + "/queries.json", {"user": f"u{i}", "num": 3})
+            series = _scrape(base, min_series=25)
+            gen = (("generation", str(qs._serving_gen)),)
+            busy = series[("pio_device_busy_fraction", gen)]
+            assert 0.0 < busy <= 1.0
+            assert series[("pio_device_flops_per_s", gen)] > 0
+            assert series[("pio_device_hbm_gbps", gen)] > 0
+            assert series[("pio_device_dispatches_total", gen)] >= 1
+            assert series[("pio_device_busy_seconds", gen)] > 0
+            # the CPU fallback carries a peak-table entry, so mfu/hbm_util
+            # are real numbers even off-TPU — the acceptance bar
+            assert series[("pio_device_mfu", gen)] > 0
+            assert series[("pio_device_hbm_util", gen)] > 0
+            # fastpath stats carry the same snapshot + the cost sources
+            dev = qs._fastpath_stats()["devprof"]
+            assert dev["dispatches_total"] >= 1
+            d = qs._deployed
+            scorer = d.algorithms[0]._scorers[id(d.models[0])]
+            costs = scorer._fastpath.devprof.costs()
+            assert costs  # every bucket annotated at compile time
+            assert all(
+                c["source"] in ("xla", "analytic") and c["flops"] > 0
+                for c in costs.values()
+            )
+        finally:
+            qs.stop()
+
+    def test_slow_json_stage_annotated_exemplars(self, trained):
+        qs, base = self._server(trained, batching=True)
+        try:
+            # every request sampled, median threshold: outliers are just
+            # the slower half of natural jitter — no timing games needed
+            qs.telemetry.tracer.sample_rate = 1.0
+            qs.telemetry.tracer._acc = 0.0
+            qs.telemetry.tracer.slow_quantile = 0.5
+            for i in range(48):
+                _post(base + "/queries.json",
+                      {"user": f"u{i % 10}", "num": 3})
+            doc, deadline = None, time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                _, body, _ = _get(base + "/trace/slow.json?limit=10")
+                doc = json.loads(body.decode())
+                if doc["retained"] > 0:
+                    break
+                time.sleep(0.02)
+            assert doc["service"] == "queryserver"
+            assert doc["quantile"] == 0.5
+            assert doc["retained"] > 0, doc
+            assert doc["thresholdMs"] is not None
+            assert doc["traces"], doc
+            for tr in doc["traces"]:
+                # an exemplar explains itself: full stage breakdown that
+                # reconciles with the wall
+                assert tr["wallMs"] is not None
+                assert "other" in tr["stagesMs"]
+                assert sum(tr["stagesMs"].values()) == pytest.approx(
+                    tr["wallMs"], abs=0.05
+                )
+            # at rate 1.0 the ring may also hold slow scrape GETs; the
+            # QUERY exemplars must carry the batch context
+            queries = [t for t in doc["traces"]
+                       if "queries" in t.get("name", "")]
+            assert queries, doc["traces"]
+            for tr in queries:
+                assert "batch" in tr.get("meta", {}), tr
+            # recorder health is on /metrics too
+            series = _scrape(base)
+            assert series[("pio_slow_trace_retained", ())] > 0
+            assert series[("pio_slow_trace_threshold_seconds", ())] > 0
+        finally:
+            qs.stop()
+
+    def test_debug_profile_writes_readable_trace(
+        self, trained, tmp_path, monkeypatch
+    ):
+        import os
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        qs, base = self._server(trained, batching=True)
+        try:
+            status, body, _ = _post(base + "/debug/profile?ms=30", {})
+            assert status == 200
+            doc = json.loads(body.decode())
+            assert doc["ms"] == 30
+            assert doc["path"].startswith(str(tmp_path))
+            captured = [
+                os.path.join(root, f)
+                for root, _, files in os.walk(doc["path"])
+                for f in files
+            ]
+            assert captured, f"empty profile dir {doc['path']}"
+            assert any(os.path.getsize(p) > 0 for p in captured)
+            series = _scrape(base)
+            assert series[("pio_profile_captures_total", ())] == 1
+            assert series[("pio_profile_last_capture_unix", ())] > 0
+        finally:
+            qs.stop()
+
+    def test_debug_profile_rejects_bad_ms_and_honors_kill_switch(
+        self, trained, monkeypatch
+    ):
+        qs, base = self._server(trained)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base + "/debug/profile?ms=banana", {})
+            assert ei.value.code == 400
+            monkeypatch.setenv("PIO_PROFILE_ENDPOINT", "0")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base + "/debug/profile?ms=10", {})
+            assert ei.value.code == 403
+        finally:
+            qs.stop()
+
+    def test_cache_hit_trace_has_no_device_stages(self, trained):
+        """Satellite: device time is charged once per dispatch — a
+        result-cache hit never reaches the device, and its trace must say
+        so while still reconciling stage sum ≡ wall."""
+        from predictionio_tpu.serving.result_cache import ResultCache
+
+        qs, base = self._server(
+            trained, batching=True, result_cache=ResultCache()
+        )
+        try:
+            q = {"user": "u1", "num": 3}
+            _post(base + "/queries.json", q)  # fill the cache
+            before = qs._fastpath_stats()["devprof"]["dispatches_total"]
+            rid = uuid.uuid4().hex[:16]
+            _post(base + "/queries.json", q,
+                  headers={obs.TRACE_HEADER: rid})
+            mine, deadline = [], time.monotonic() + 5.0
+            while not mine and time.monotonic() < deadline:
+                _, body, _ = _get(base + "/trace/recent.json")
+                doc = json.loads(body.decode())
+                mine = [t for t in doc["traces"]
+                        if t["requestId"] == rid]
+                if not mine:
+                    time.sleep(0.02)
+            assert mine, doc["traces"]
+            tr = mine[0]
+            assert tr["meta"]["cache"] == "hit", tr
+            for stage in ("device_compute", "h2d", "batch_assembly",
+                          "queue_wait"):
+                assert stage not in tr["stagesMs"], tr
+            assert sum(tr["stagesMs"].values()) == pytest.approx(
+                tr["wallMs"], abs=0.05
+            )
+            # and the accountant never saw a dispatch for the hit
+            after = qs._fastpath_stats()["devprof"]["dispatches_total"]
+            assert after == before
+        finally:
+            qs.stop()
+
+
 class TestEventServerTelemetry:
     @pytest.fixture()
     def served(self, storage):

@@ -171,6 +171,22 @@ def main() -> int:
         "p50_off_ms": obs.get("p50_off_ms"),
         "metric_series": obs.get("metric_series"),
     }
+    # serving-utilization gate (ISSUE 8): the live device accountant must
+    # report real, non-null rates under the primary cell's loadtest — a
+    # null or zero here means the serving path stopped recording
+    # cost-annotated dispatches and MFU went back to being unmeasured
+    su = http.get("serving_utilization") or {}
+    artifact["serving_utilization"] = {
+        "busy_fraction": su.get("busy_fraction"),
+        "flops_per_s": su.get("flops_per_s"),
+        "mfu": su.get("mfu"),
+        "hbm_util": su.get("hbm_util"),
+        "dispatches": su.get("dispatches"),
+        "gate_pass": all(
+            isinstance(su.get(k), (int, float)) and su.get(k) > 0
+            for k in ("busy_fraction", "flops_per_s", "mfu")
+        ),
+    }
     # static-analysis gate: perf numbers from a repo carrying hot-path or
     # race hazards are not publishable — `pio analyze` must report zero
     # errors for the matrix to count
@@ -205,6 +221,7 @@ def main() -> int:
         "ingest": artifact["ingest"],
         "durability": artifact["durability"],
         "observability": artifact["observability"],
+        "serving_utilization": artifact["serving_utilization"],
         "analysis": artifact["analysis"],
     }))
     return 0 if all_tpu else 1
