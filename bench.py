@@ -952,6 +952,122 @@ def _durability_bench() -> dict:
     }
 
 
+def _kernel_bench(platform: str, n_items: int, rank: int) -> dict:
+    """Score-kernel block: fused Pallas vs XLA reference, per factor dtype.
+
+    Two kinds of evidence per dtype (f32/bf16/int8):
+
+    * **Analytic roofline** at the artifact's serving shape — arithmetic
+      intensity (FLOPs/byte) of one top-bucket dispatch for both kernels
+      and the TPU-roofline MFU each can attain (min(peak, intensity·bw)
+      / peak).  The fused kernel never round-trips the (B, I) score
+      matrix through HBM, so its intensity gain over the reference is
+      the headline number and the matrix gate (fused ≥ reference).
+    * **Measured scores/s**, TPU only — on CPU the fused path runs the
+      Pallas *interpreter*, so timing it would bench the interpreter,
+      not the kernel; CPU artifacts carry ``measured: null``.
+
+    Resident factor bytes per dtype come from actually quantizing a
+    factor pair at the bench shape (scales included), so the int8 ≤ ½
+    acceptance line is measured, not asserted.
+    """
+    import jax
+
+    from predictionio_tpu.obs.devprof import (
+        PEAKS, fused_score_cost, score_cost,
+    )
+    from predictionio_tpu.ops.quantize import quantize_factors
+    from predictionio_tpu.ops.topk import gather_score_topk
+
+    batch = int(os.environ.get("BENCH_KERNEL_BATCH", 256))
+    top_k = int(os.environ.get("BENCH_KERNEL_TOPK", 100))
+    peak = PEAKS["tpu"]  # roofline projection is against the TPU target
+
+    rng = np.random.default_rng(11)
+    n_users = max(batch * 4, 1024)
+    U = rng.standard_normal((n_users, rank)).astype(np.float32)
+    V = rng.standard_normal((n_items, rank)).astype(np.float32)
+
+    def roofline(flops: float, nbytes: float) -> dict:
+        intensity = flops / nbytes
+        attainable = min(peak["flops"], intensity * peak["hbm_gbps"])
+        return {
+            "intensity_flops_per_byte": round(intensity, 3),
+            "roofline_mfu": round(attainable / peak["flops"], 4),
+        }
+
+    on_tpu = platform == "tpu"
+    out: dict = {
+        "shape": {
+            "batch": batch, "items": n_items, "rank": rank, "top_k": top_k,
+        },
+        "measured_backend": platform if on_tpu else None,
+        "dtypes": {},
+    }
+    f32_bytes = None
+    for dtype in ("f32", "bf16", "int8"):
+        Uq, us = quantize_factors(U, dtype)
+        Vq, vs = quantize_factors(V, dtype)
+        resident = sum(
+            int(a.nbytes) for a in (Uq, Vq, us, vs) if a is not None
+        )
+        if dtype == "f32":
+            f32_bytes = resident
+        ref = roofline(*score_cost(batch, n_items, rank, dtype=dtype))
+        fused = roofline(
+            *fused_score_cost(batch, n_items, rank, top_k, dtype=dtype)
+        )
+        cell = {
+            "reference": ref,
+            "fused": fused,
+            "intensity_gain": round(
+                fused["intensity_flops_per_byte"]
+                / ref["intensity_flops_per_byte"], 2
+            ),
+            "resident_factor_bytes": resident,
+            "resident_vs_f32": round(resident / f32_bytes, 4),
+        }
+        if on_tpu:
+            # measured A/B: same inputs, both backends, scores/s
+            u_idx = rng.integers(0, n_users, batch).astype(np.int32)
+            measured = {}
+            for backend in ("reference", "fused"):
+                fn = jax.jit(
+                    lambda U_, V_, us_, vs_, idx_, _b=backend:
+                    gather_score_topk(
+                        U_, V_, idx_, top_k, u_scale=us_, v_scale=vs_,
+                        backend=_b,
+                    )
+                )
+                r = fn(Uq, Vq, us, vs, u_idx)
+                jax.block_until_ready(r)  # compile + warm
+                iters = int(os.environ.get("BENCH_KERNEL_ITERS", 30))
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    r = fn(Uq, Vq, us, vs, u_idx)
+                jax.block_until_ready(r)
+                dt = time.perf_counter() - t0
+                measured[backend] = round(batch * n_items * iters / dt, 1)
+            cell["measured_scores_per_sec"] = measured
+            cell["measured_gain"] = round(
+                measured["fused"] / measured["reference"], 2
+            )
+        out["dtypes"][dtype] = cell
+
+    f32 = out["dtypes"]["f32"]
+    int8 = out["dtypes"]["int8"]
+    # matrix gates: the fused kernel must not be below the reference on
+    # the analytic model (and on silicon when measured), and int8 must at
+    # least halve the resident factor footprint
+    out["intensity_gain_f32"] = f32["intensity_gain"]
+    out["int8_resident_vs_f32"] = int8["resident_vs_f32"]
+    gate = f32["intensity_gain"] >= 1.0 and int8["resident_vs_f32"] <= 0.5
+    if on_tpu:
+        gate = gate and f32.get("measured_gain", 0.0) >= 1.0
+    out["gate_pass"] = bool(gate)
+    return out
+
+
 def main() -> None:
     # BENCH_PLATFORM=cpu skips the (slow) tunnel probe for local iteration
     forced_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
@@ -1127,6 +1243,18 @@ def main() -> None:
             print(f"WARNING: observability bench failed: {e}", file=sys.stderr)
             observability = {"error": str(e)}
         print(f"INFO: observability: {observability}", file=sys.stderr)
+    kernel = None
+    if os.environ.get("BENCH_KERNEL", "1") != "0":
+        try:
+            kernel = _kernel_bench(
+                platform,
+                int(os.environ.get("BENCH_KERNEL_ITEMS", n_items)),
+                rank,
+            )
+        except Exception as e:  # the kernel A/B must never kill the artifact
+            print(f"WARNING: kernel bench failed: {e}", file=sys.stderr)
+            kernel = {"error": str(e)}
+        print(f"INFO: kernel: {kernel}", file=sys.stderr)
     record = {
         "metric": "als_train_events_per_sec_per_chip",
         "value": round(value, 1),
@@ -1163,6 +1291,8 @@ def main() -> None:
         record["durability"] = durability
     if observability is not None:
         record["observability"] = observability
+    if kernel is not None:
+        record["kernel"] = kernel
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

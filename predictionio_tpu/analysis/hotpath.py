@@ -134,7 +134,10 @@ def traced_functions(mod: Module) -> dict[ast.FunctionDef, set[str]]:
 
     Covers ``@jax.jit``, ``@partial(jax.jit, static_argnames=...)``,
     ``@jax.jit(static_argnames=...)``, ``f = jax.jit(f)`` wrapping, and
-    kernels handed to ``pl.pallas_call``.
+    kernels handed to ``pl.pallas_call`` — bare (``pallas_call(kernel)``)
+    or specialised (``pallas_call(partial(kernel, k=..., block_i=...))``,
+    where the partial's bound keywords are static by construction and
+    excluded from taint, same as ``static_argnames``).
     """
     if mod.tree is None:
         return {}
@@ -168,6 +171,8 @@ def traced_functions(mod: Module) -> dict[ast.FunctionDef, set[str]]:
             continue
         target: Optional[str] = None
         call: Optional[ast.Call] = None
+        extra_static: set[str] = set()
+        is_pallas = False
         if _is_jit_ref(node.func) and node.args and isinstance(
             node.args[0], ast.Name
         ):
@@ -176,15 +181,46 @@ def traced_functions(mod: Module) -> dict[ast.FunctionDef, set[str]]:
             isinstance(node.func, ast.Attribute)
             and node.func.attr == "pallas_call"
             and node.args
-            and isinstance(node.args[0], ast.Name)
         ):
-            target, call = node.args[0].id, None
+            is_pallas = True
+            kernel_arg = node.args[0]
+            if isinstance(kernel_arg, ast.Name):
+                target = kernel_arg.id
+            else:
+                target, extra_static = _partial_kernel(kernel_arg)
         if target is None:
             continue
-        fn = by_scope_name.get((scope_of(node), target))
+        # kernels/jitted fns are often module-level while the launch call
+        # sits inside a wrapper function: fall back to module scope
+        fn = by_scope_name.get((scope_of(node), target)) or \
+            by_scope_name.get((id(mod.tree), target))
         if fn is not None and fn not in out:
-            out[fn] = _static_params(call, fn)
+            if is_pallas:
+                # Pallas hands refs positionally; a kernel's keyword-only
+                # params can only be partial-bound compile-time constants
+                # (even through a `partial(k, **common)` splat)
+                extra_static |= {a.arg for a in fn.args.kwonlyargs}
+            out[fn] = _static_params(call, fn) | extra_static
     return out
+
+
+def _partial_kernel(node: ast.expr) -> tuple[Optional[str], set[str]]:
+    """Unwrap ``partial(kernel, k=..., ...)`` / ``functools.partial(...)``
+    handed to ``pallas_call``; the bound keywords are compile-time
+    constants (Pallas specialisation idiom, ``ops/score_kernel.py``)."""
+    if not isinstance(node, ast.Call):
+        return None, set()
+    fname = (
+        node.func.attr if isinstance(node.func, ast.Attribute)
+        else getattr(node.func, "id", "")
+    )
+    if fname != "partial" or not node.args or not isinstance(
+        node.args[0], ast.Name
+    ):
+        return None, set()
+    return node.args[0].id, {
+        kw.arg for kw in node.keywords if kw.arg is not None
+    }
 
 
 def _live_taint(

@@ -35,6 +35,50 @@ logger = logging.getLogger(__name__)
 UTC = _dt.timezone.utc
 
 
+def quantized_topk_overlap(
+    user_factors,
+    item_factors,
+    user_q,
+    user_scale,
+    item_q,
+    item_scale,
+    k: int = 100,
+    sample: int = 256,
+) -> float:
+    """Mean top-k overlap of quantized vs fp32 scoring — the publish gate.
+
+    For an evenly-spaced deterministic sample of users, ranks the catalog
+    with the fp32 factors and with the dequantized quantized variant
+    (``ops/quantize.py``), and returns the mean ``|topk ∩ topk_q| / k``
+    over the sample.  A quantized generation whose overlap falls below
+    ``PIO_QUANT_MIN_OVERLAP`` is refused at publish (``models/als.py``) —
+    serving keeps the fp32 factors, so a lossy quantization can never
+    silently change what users are recommended.  Host numpy throughout:
+    this runs once per publish, off the serving path.
+    """
+    import numpy as np
+
+    from predictionio_tpu.ops.quantize import dequantize_factors
+
+    U = np.asarray(user_factors, np.float32)
+    V = np.asarray(item_factors, np.float32)
+    n_users, n_items = U.shape[0], V.shape[0]
+    k = min(k, n_items)
+    n = min(max(1, sample), n_users)
+    users = np.unique(
+        np.linspace(0, n_users - 1, n).round().astype(np.int64)
+    )
+    Uq = dequantize_factors(user_q, user_scale)
+    Vq = dequantize_factors(item_q, item_scale)
+    ref = np.argpartition(-(U[users] @ V.T), k - 1, axis=1)[:, :k]
+    quant = np.argpartition(-(Uq[users] @ Vq.T), k - 1, axis=1)[:, :k]
+    overlaps = [
+        len(np.intersect1d(r, q, assume_unique=True)) / k
+        for r, q in zip(ref, quant)
+    ]
+    return float(np.mean(overlaps))
+
+
 class EngineParamsGenerator:
     """Parity: EngineParamsGenerator.scala:30."""
 

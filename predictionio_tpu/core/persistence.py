@@ -62,6 +62,32 @@ def open_model_blob(blob: bytes) -> bytes:
     return payload
 
 
+def seal_blob_file(path: str, payload: bytes) -> None:
+    """Atomically write ``payload`` to ``path`` inside the checksum
+    envelope (tmp + rename, so a crash mid-write leaves either the old
+    file or none — never a torn blob that passes ``startswith`` but fails
+    later).  Sidecar artifacts (e.g. quantized factor variants) seal
+    through this so deploy gets the same integrity guarantee as the
+    MODELDATA blob itself."""
+    import os
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(seal_model_blob(payload))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def open_blob_file(path: str) -> bytes:
+    """Read and verify a :func:`seal_blob_file` artifact; raises
+    :class:`ModelIntegrityError` on checksum mismatch, ``OSError`` when
+    missing — both of which deploy treats as 'variant unavailable' and
+    degrades to the base (fp32) model rather than failing the load."""
+    with open(path, "rb") as f:
+        return open_model_blob(f.read())
+
+
 class _RetrainSentinel:
     def __repr__(self) -> str:
         return "RETRAIN"

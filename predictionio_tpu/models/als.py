@@ -112,6 +112,15 @@ class ALSModel:
     user_map: BiMap
     item_map: BiMap
     config: ALSConfig = None
+    # quantized serving variant (ops/quantize.py), produced at publish and
+    # accuracy-gated there; "f32" means the variant is absent and serving
+    # uses the float32 factors above. The fp32 factors are ALWAYS kept —
+    # exact scoring, evaluation, and quantization rollback need them.
+    factor_dtype: str = "f32"
+    user_factors_q: Optional[np.ndarray] = None
+    user_scale: Optional[np.ndarray] = None
+    item_factors_q: Optional[np.ndarray] = None
+    item_scale: Optional[np.ndarray] = None
 
     def predict_rating(self, user_idx: int, item_idx: int) -> float:
         return float(self.user_factors[user_idx] @ self.item_factors[item_idx])
@@ -1128,13 +1137,77 @@ class CheckpointedALSModel(ALSModel):
             {"user_factors": self.user_factors, "item_factors": self.item_factors},
         )
         if distributed.should_write_storage():
+            quant_meta = self._publish_quantized(d)
             with open(os.path.join(d, "maps.pkl"), "wb") as f:
                 pickle.dump(
                     {"user_map": self.user_map, "item_map": self.item_map,
-                     "config": self.config},
+                     "config": self.config, "quant": quant_meta},
                     f,
                 )
         return True  # manifest mode: MODELDATA stores only the class path
+
+    def _publish_quantized(self, d: str) -> dict:
+        """Offline quantize step at model publish (PIO_QUANT_DTYPE).
+
+        Produces the bf16/int8 factor variant, measures its top-k overlap
+        against fp32 (:func:`core.evaluation.quantized_topk_overlap`), and
+        only if the overlap clears ``PIO_QUANT_MIN_OVERLAP`` seals the
+        variant through the persistence checksum envelope
+        (``quant.blob``).  A refused variant leaves no blob — serving
+        keeps the fp32 generation.  Returns the manifest record (always
+        written, so the refusal and its measured overlap are auditable).
+        """
+        import os
+        import pickle
+
+        from predictionio_tpu.core import evaluation as _evaluation
+        from predictionio_tpu.core import persistence as _persistence
+        from predictionio_tpu.ops import quantize as _quantize
+
+        dtype = (os.environ.get("PIO_QUANT_DTYPE") or "auto").strip().lower()
+        if dtype in ("auto", "f32", ""):
+            return {"dtype": "f32"}
+        user_q, user_scale = _quantize.quantize_factors(
+            self.user_factors, dtype
+        )
+        item_q, item_scale = _quantize.quantize_factors(
+            self.item_factors, dtype
+        )
+        k = min(100, self.item_factors.shape[0])
+        threshold = float(os.environ.get("PIO_QUANT_MIN_OVERLAP", "0.98"))
+        sample = int(os.environ.get("PIO_QUANT_EVAL_USERS", "256") or 256)
+        overlap = _evaluation.quantized_topk_overlap(
+            self.user_factors, self.item_factors,
+            user_q, user_scale, item_q, item_scale,
+            k=k, sample=sample,
+        )
+        if overlap < threshold:
+            logger.warning(
+                "quantized publish REFUSED: %s top-%d overlap %.4f < %.4f "
+                "(PIO_QUANT_MIN_OVERLAP); serving keeps fp32",
+                dtype, k, overlap, threshold,
+            )
+            return {
+                "dtype": "f32", "refused": dtype,
+                "topk_overlap": overlap, "threshold": threshold, "k": k,
+            }
+        payload = pickle.dumps(
+            {
+                "dtype": dtype,
+                "user_factors_q": user_q, "user_scale": user_scale,
+                "item_factors_q": item_q, "item_scale": item_scale,
+            },
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        _persistence.seal_blob_file(os.path.join(d, "quant.blob"), payload)
+        logger.info(
+            "quantized publish: %s factors sealed (top-%d overlap %.4f >= "
+            "%.4f)", dtype, k, overlap, threshold,
+        )
+        return {
+            "dtype": dtype, "topk_overlap": overlap,
+            "threshold": threshold, "k": k,
+        }
 
     @classmethod
     def load(cls, instance_id: str, params, ctx) -> "CheckpointedALSModel":
@@ -1147,13 +1220,69 @@ class CheckpointedALSModel(ALSModel):
         factors = restore_pytree(os.path.join(d, "factors"))
         with open(os.path.join(d, "maps.pkl"), "rb") as f:
             meta = pickle.load(f)
-        return cls(
+        model = cls(
             user_factors=np.asarray(factors["user_factors"]),
             item_factors=np.asarray(factors["item_factors"]),
             user_map=meta["user_map"],
             item_map=meta["item_map"],
             config=meta["config"],
         )
+        cls._load_quantized(model, d, meta.get("quant") or {})
+        return model
+
+    @staticmethod
+    def _load_quantized(model: "CheckpointedALSModel", d: str, quant: dict):
+        """Attach the published quantized variant, if any and wanted.
+
+        ``PIO_QUANT_DTYPE`` at deploy: ``auto`` (default) serves whatever
+        dtype the manifest recorded; ``f32`` is the rollback switch —
+        ignore the variant and serve fp32; an explicit ``bf16``/``int8``
+        must match the artifact or fp32 is served with a warning.  Any
+        failure to open the sealed blob (missing file, checksum mismatch
+        → :class:`ModelIntegrityError`) degrades to fp32 — the quantized
+        variant is an optimization, never a single point of failure.
+        """
+        import os
+        import pickle
+
+        from predictionio_tpu.core import persistence as _persistence
+
+        recorded = quant.get("dtype", "f32")
+        want = (os.environ.get("PIO_QUANT_DTYPE") or "auto").strip().lower()
+        effective = recorded if want in ("auto", "") else want
+        if effective in ("f32",) or recorded == "f32":
+            if want in ("bf16", "int8") and recorded != want:
+                logger.warning(
+                    "PIO_QUANT_DTYPE=%s but artifact records %s; serving "
+                    "fp32", want, recorded,
+                )
+            return
+        if effective != recorded:
+            logger.warning(
+                "PIO_QUANT_DTYPE=%s but artifact records %s; serving fp32",
+                want, recorded,
+            )
+            return
+        try:
+            payload = pickle.loads(
+                _persistence.open_blob_file(os.path.join(d, "quant.blob"))
+            )
+            model.factor_dtype = payload["dtype"]
+            model.user_factors_q = payload["user_factors_q"]
+            model.user_scale = payload["user_scale"]
+            model.item_factors_q = payload["item_factors_q"]
+            model.item_scale = payload["item_scale"]
+            logger.info(
+                "loaded %s quantized factors (top-k overlap %.4f at "
+                "publish)", payload["dtype"], quant.get("topk_overlap", -1.0),
+            )
+        except (
+            _persistence.ModelIntegrityError, OSError, KeyError,
+            pickle.UnpicklingError, EOFError,
+        ) as e:
+            logger.warning(
+                "quantized factors unavailable (%s); serving fp32", e
+            )
 
 
 # PersistentModel registration: dataclass inheritance keeps ALSModel's fields;
@@ -1257,12 +1386,27 @@ class ALSScorer:
                 if fp is None:
                     from predictionio_tpu.serving.fastpath import BucketedScorer
 
-                    fp = BucketedScorer(
-                        self.ctx,
-                        self.model.user_factors,
-                        self.model.item_factors,
-                        max_k=max_k or self.max_k,
-                    )
+                    m = self.model
+                    dtype = getattr(m, "factor_dtype", "f32")
+                    if dtype != "f32" and m.user_factors_q is not None:
+                        # published quantized variant: device-resident
+                        # narrow factors, dequantized in-kernel
+                        fp = BucketedScorer(
+                            self.ctx,
+                            m.user_factors_q,
+                            m.item_factors_q,
+                            max_k=max_k or self.max_k,
+                            factor_dtype=dtype,
+                            user_scale=m.user_scale,
+                            item_scale=m.item_scale,
+                        )
+                    else:
+                        fp = BucketedScorer(
+                            self.ctx,
+                            m.user_factors,
+                            m.item_factors,
+                            max_k=max_k or self.max_k,
+                        )
                     self._fastpath = fp
         return fp
 

@@ -200,6 +200,42 @@ def bridge_fastpath(
                     [("", (), _num(hot.get("resident")))],
                 ),
             ])
+        kern = s.get("kernel")
+        if isinstance(kern, dict):
+            fams.extend([
+                _fam(
+                    "pio_kernel_info", "gauge",
+                    "Active score-kernel backend and factor dtype "
+                    "(info gauge, constant 1; the labels are the signal).",
+                    [(
+                        "",
+                        (
+                            ("backend", str(kern.get("backend", ""))),
+                            ("dtype", str(kern.get("factor_dtype", ""))),
+                        ),
+                        1.0,
+                    )],
+                ),
+                _fam(
+                    "pio_kernel_resident_factor_bytes", "gauge",
+                    "Device-resident factor storage (quantized when a "
+                    "bf16/int8 variant is live; int8 ≈ ¼ of fp32).",
+                    [("", (), _num(kern.get("resident_factor_bytes")))],
+                ),
+                _fam(
+                    "pio_kernel_intensity_flops_per_byte", "gauge",
+                    "Analytic arithmetic intensity of the top scoring "
+                    "rung; fused ≫ reference because scores never round-"
+                    "trip through HBM.",
+                    [("", (), _num(kern.get("intensity_flops_per_byte")))],
+                ),
+                _fam(
+                    "pio_kernel_warmup_executions_total", "counter",
+                    "Bucket rungs executed at deploy-time warmup (each "
+                    "rung runs once so no compile happens under load).",
+                    [("", (), _num(kern.get("warmup_executions")))],
+                ),
+            ])
         return fams
 
     registry.register_collector(collect)

@@ -118,6 +118,11 @@ def train_utilization(
     }
 
 
+# bytes per factor element by serving dtype (mirrors ops/quantize.py;
+# duplicated here so the obs layer never imports the ops layer)
+_FACTOR_BYTES = {"f32": 4.0, "bf16": 2.0, "int8": 1.0}
+
+
 def score_cost(
     batch: int, n_items: int, rank: int, dtype: str = "f32"
 ) -> tuple[float, float]:
@@ -130,9 +135,36 @@ def score_cost(
     result write.
     """
     b, i, k = float(batch), float(n_items), float(rank)
-    s = 2.0 if dtype == "bf16" else 4.0
+    s = _FACTOR_BYTES.get(dtype, 4.0)
     flops = b * i * (2.0 * k + 8.0)
-    nbytes = i * k * s + b * k * s + 2.0 * b * i * s + b * k * 8.0
+    # quantized reference still materializes the dequantized f32 copy and
+    # the f32 score matrix; only the factor stream itself narrows
+    nbytes = i * k * s + b * k * s + 2.0 * b * i * 4.0 + b * k * 8.0
+    return flops, nbytes
+
+
+def fused_score_cost(
+    batch: int, n_items: int, rank: int, top_k: int, dtype: str = "f32"
+) -> tuple[float, float]:
+    """Analytic (FLOPs, HBM bytes) of one FUSED score+top-k dispatch.
+
+    The Pallas kernel (``ops/score_kernel.py``) keeps the score matrix in
+    VMEM, so the reference model's dominant ``2·B·I·4`` HBM round-trip
+    term disappears: bytes are just the one-pass factor stream (at the
+    storage dtype — this is where bf16/int8 pay off), the B gathered user
+    rows, the int8 per-row scales when present, the mask stream, and the
+    (B, k) result write.  FLOPs match the reference (same matmul + ~8
+    ops/score of masking/merge work), so the fused intensity gain is the
+    byte reduction, directly.
+    """
+    b, i, r, k = float(batch), float(n_items), float(rank), float(top_k)
+    s = _FACTOR_BYTES.get(dtype, 4.0)
+    flops = b * i * (2.0 * r + 8.0)
+    nbytes = i * r * s + b * r * s  # item stream + gathered user rows
+    if dtype == "int8":
+        nbytes += (i + b) * 4.0  # per-row f32 scales
+    nbytes += i * 1.0  # int8 exclusion-mask stream
+    nbytes += b * 4.0 + b * k * 8.0  # index upload + (vals, idx) readback
     return flops, nbytes
 
 

@@ -1,11 +1,64 @@
-"""Top-k selection with masking — the serving-side ranking primitive."""
+"""Top-k selection with masking — the serving-side ranking primitive.
+
+:func:`gather_score_topk` is the ONE public entrypoint for the serving
+score path; everything (fastpath, tests, bench) calls through it.  It
+dispatches between two backends behind a single seam:
+
+* ``reference`` — plain XLA: gather, dot, ``lax.top_k`` as separate ops
+  (the (B, n_items) score matrix exists as an XLA intermediate in HBM).
+* ``fused`` — the Pallas kernel (``ops/score_kernel.py``): gather, dot,
+  and a masked running top-k in one kernel, factors staying in VMEM
+  between stages.  Off-TPU the same kernel runs in interpret mode.
+
+Selection: the ``backend=`` argument wins, else ``PIO_SCORE_KERNEL``
+(``fused`` | ``reference`` | ``auto``, default ``auto``).  ``auto`` picks
+the fused kernel ONLY on TPU — it never silently selects the TPU kernel
+on CPU, where interpret mode would lose badly; forcing ``fused`` off-TPU
+is explicit opt-in (that is how the CPU equivalence tests run the real
+kernel).  ``PIO_NATIVE=0`` (the repo-wide native kill switch) forces
+``reference`` regardless.
+
+Quantized factors (bf16 / int8 + per-row scales, ``ops/quantize.py``) are
+accepted by both backends: the reference path dequantizes in XLA before
+the matmul, the fused path dequantizes in VMEM after the HBM stream —
+identical math, so the equivalence suite can compare them bit-for-bit.
+"""
 
 from __future__ import annotations
+
+import os
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = jnp.float32(-1e30)
+
+BACKENDS = ("fused", "reference", "auto")
+
+
+def resolve_backend(requested: Optional[str] = None) -> str:
+    """Resolve the score-path backend: ``"fused"`` or ``"reference"``.
+
+    ``requested`` overrides ``PIO_SCORE_KERNEL``; ``auto`` (the default)
+    takes the fused kernel only on TPU.  ``PIO_NATIVE=0`` forces the
+    reference path — the same kill switch that disables every other
+    native kernel in the repo.
+    """
+    req = (
+        requested or os.environ.get("PIO_SCORE_KERNEL") or "auto"
+    ).strip().lower()
+    if req not in BACKENDS:
+        raise ValueError(
+            f"PIO_SCORE_KERNEL must be one of {BACKENDS}, got {req!r}"
+        )
+    if os.environ.get("PIO_NATIVE", "1") == "0":
+        return "reference"
+    if req == "auto":
+        from predictionio_tpu.ops import score_kernel
+
+        return "fused" if score_kernel.use_fused_default() else "reference"
+    return req
 
 
 def top_k_with_mask(scores: jax.Array, k: int, mask: jax.Array | None = None):
@@ -18,18 +71,49 @@ def top_k_with_mask(scores: jax.Array, k: int, mask: jax.Array | None = None):
     return jax.lax.top_k(scores, k)
 
 
+def _dequantize(F: jax.Array, scale: Optional[jax.Array]) -> jax.Array:
+    """XLA-side dequantize: the f32 math the fused kernel does in VMEM."""
+    if F.dtype != jnp.float32:
+        F = F.astype(jnp.float32)
+    if scale is not None:
+        F = F * scale
+    return F
+
+
 def gather_score_topk(
     U: jax.Array, V: jax.Array, u_idx: jax.Array, k: int,
     item_mask: jax.Array | None = None,
+    *,
+    u_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    backend: Optional[str] = None,
+    interpret: Optional[bool] = None,
 ):
     """Fused gather→score→top-k: the serving fast-path device program.
 
-    ``U[u_idx] @ V.T`` then masked top-k, all inside one jitted program —
-    the (B, n_items) score matrix lives only as an XLA intermediate and is
-    never materialized on host.  ``item_mask`` is True for slots that must
-    never win (padded item tail, blacklists); it broadcasts over the batch.
-    Returns ``(values (B, k), indices (B, k))``.
+    ``U[u_idx] @ V.T`` then masked top-k — as one Pallas kernel on the
+    fused backend, or separate XLA ops on the reference backend (see the
+    module docstring for the dispatch rules).  ``item_mask`` is True for
+    slots that must never win (padded item tail, blacklists); it
+    broadcasts over the batch.  ``u_scale``/``v_scale`` are the per-row
+    int8 scales from :mod:`ops.quantize`.  Returns
+    ``(values (B, k), indices (B, k))``.
     """
-    scores = U[u_idx] @ V.T  # (B, rank) @ (rank, n_items_pad)
+    be = resolve_backend(backend)
+    if be == "fused":
+        from predictionio_tpu.ops import score_kernel
+
+        return score_kernel.fused_gather_score_topk(
+            U, V, u_idx, k, item_mask,
+            u_scale=u_scale, v_scale=v_scale, interpret=interpret,
+        )
+    Uf = _dequantize(U, u_scale)
+    # item scale applies AFTER the matmul (scores scale per item column) —
+    # the same op order as the fused kernel, so the two backends round
+    # identically and the equivalence suite can compare them exactly
+    Vf = _dequantize(V, None)
+    scores = Uf[u_idx] @ Vf.T  # (B, rank) @ (rank, n_items_pad)
+    if v_scale is not None:
+        scores = scores * v_scale.reshape(1, -1)
     mask = item_mask[None, :] if item_mask is not None else None
     return top_k_with_mask(scores, k, mask=mask)

@@ -46,7 +46,8 @@ import numpy as np
 
 from predictionio_tpu.obs import devprof as _devprof
 from predictionio_tpu.obs import tracing as _tracing
-from predictionio_tpu.ops.topk import gather_score_topk
+from predictionio_tpu.ops import score_kernel as _score_kernel
+from predictionio_tpu.ops.topk import gather_score_topk, resolve_backend
 from predictionio_tpu.parallel.mesh import MeshContext, pad_to_multiple
 from predictionio_tpu.utils import profiling as _profiling
 
@@ -77,21 +78,62 @@ class BucketedScorer:
         buckets=BUCKETS,
         hot_size: Optional[int] = None,
         hot_refresh_queries: Optional[int] = None,
+        factor_dtype: str = "f32",
+        user_scale: Optional[np.ndarray] = None,
+        item_scale: Optional[np.ndarray] = None,
+        backend: Optional[str] = None,
     ):
         self.ctx = ctx
         self.n_users = user_factors.shape[0]
         self.n_items = item_factors.shape[0]
-        self._n_items_pad = pad_to_multiple(self.n_items, 8)
+        # score-kernel backend for THIS scorer generation, resolved once at
+        # construction (PIO_SCORE_KERNEL; auto → fused only on TPU)
+        self.backend = resolve_backend(backend)
+        self.factor_dtype = factor_dtype
+        if factor_dtype == "int8" and (user_scale is None or item_scale is None):
+            raise ValueError("int8 factors require user_scale and item_scale")
+        if self.backend == "fused":
+            # the fused kernel streams the item matrix in fixed-size blocks
+            self._n_items_pad = _score_kernel.pad_block_items(self.n_items)
+        else:
+            self._n_items_pad = pad_to_multiple(self.n_items, 8)
         self.k = min(max_k, self.n_items)
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self._repl = ctx.replicated()
         pad_i = self._n_items_pad - self.n_items
-        self._U = ctx.replicate(np.asarray(user_factors, np.float32))
+        if factor_dtype == "f32":
+            user_factors = np.asarray(user_factors, np.float32)
+            item_factors = np.asarray(item_factors, np.float32)
+        self._U = ctx.replicate(np.asarray(user_factors))
         self._V = ctx.replicate(
-            np.pad(np.asarray(item_factors, np.float32), ((0, pad_i), (0, 0)))
+            np.pad(np.asarray(item_factors), ((0, pad_i), (0, 0)))
         )
+        if factor_dtype == "int8":
+            self._Uscale = ctx.replicate(np.asarray(user_scale, np.float32))
+            self._Vscale = ctx.replicate(
+                np.pad(
+                    np.asarray(item_scale, np.float32),
+                    ((0, pad_i), (0, 0)),
+                    constant_values=1.0,
+                )
+            )
+        else:
+            self._Uscale = self._Vscale = None
         self._item_pad_mask = ctx.replicate(
             np.arange(self._n_items_pad) >= self.n_items
+        )
+        # everything the compiled programs take except the per-call indices
+        if factor_dtype == "int8":
+            self._static_args = (
+                self._U, self._V, self._Uscale, self._Vscale,
+                self._item_pad_mask,
+            )
+        else:
+            self._static_args = (self._U, self._V, self._item_pad_mask)
+        self.resident_factor_bytes = sum(
+            int(a.nbytes)
+            for a in (self._U, self._V, self._Uscale, self._Vscale)
+            if a is not None
         )
         self._lock = threading.Lock()
         self.compile_count = 0
@@ -128,20 +170,40 @@ class BucketedScorer:
         self.devprof = _devprof.DeviceUtilization(
             platform=jax.default_backend()
         )
-        # AOT warmup: every rung compiled before the first request
+        # AOT warmup: every rung compiled before the first request, then
+        # executed once — a lazily-materialized kernel (Pallas included)
+        # can never surface its first-dispatch cost under traffic
+        self.warmup_executions = 0
         self._fns = {b: self._compile(b) for b in self.buckets}
+        for b in self.buckets:
+            dummy_idx = jax.device_put(np.zeros(b, np.int32), self._repl)
+            jax.block_until_ready(self._fns[b](*self._static_args, dummy_idx))
+            self.warmup_executions += 1
 
     def _compile(self, b: int):
         """Lower + compile the bucket-b program ahead of time."""
         k = self.k
+        be = self.backend
 
-        def fn(U, V, item_pad_mask, u_idx):
-            return gather_score_topk(U, V, u_idx, k, item_mask=item_pad_mask)
+        if self.factor_dtype == "int8":
+
+            def fn(U, V, u_scale, v_scale, item_pad_mask, u_idx):
+                return gather_score_topk(
+                    U, V, u_idx, k, item_mask=item_pad_mask,
+                    u_scale=u_scale, v_scale=v_scale, backend=be,
+                )
+
+        else:
+
+            def fn(U, V, item_pad_mask, u_idx):
+                return gather_score_topk(
+                    U, V, u_idx, k, item_mask=item_pad_mask, backend=be
+                )
 
         dummy_idx = jax.device_put(np.zeros(b, np.int32), self._repl)
         compiled = (
             jax.jit(fn)
-            .lower(self._U, self._V, self._item_pad_mask, dummy_idx)
+            .lower(*self._static_args, dummy_idx)
             .compile()
         )
         self.compile_count += 1
@@ -153,8 +215,20 @@ class BucketedScorer:
 
         Prefers the compiler's own numbers for the ACTUAL optimized HLO;
         falls back to the analytic score model when cost_analysis
-        declines (some backends return nothing useful).
+        declines (some backends return nothing useful).  Fused buckets
+        always use the analytic fused model: the Pallas call is opaque to
+        XLA's cost analysis, which would report the custom-call as ~free
+        and make MFU read as zero forever.
         """
+        rank = self._U.shape[1]
+        if self.backend == "fused":
+            a_flops, a_bytes = _devprof.fused_score_cost(
+                b, self._n_items_pad, rank, self.k, self.factor_dtype
+            )
+            self.devprof.set_cost(
+                b, a_flops, a_bytes, source="analytic-fused"
+            )
+            return
         flops = nbytes = None
         try:
             ca = compiled.cost_analysis()
@@ -169,7 +243,7 @@ class BucketedScorer:
             self.devprof.set_cost(b, flops, nbytes, source="xla")
         else:
             a_flops, a_bytes = _devprof.score_cost(
-                b, self._n_items_pad, self._U.shape[1]
+                b, self._n_items_pad, rank, dtype=self.factor_dtype
             )
             self.devprof.set_cost(b, a_flops, a_bytes, source="analytic")
 
@@ -238,9 +312,7 @@ class BucketedScorer:
                 u_dev = jax.device_put(padded, self._repl)
             with _profiling.trace(stage="device_compute"):
                 t0 = time.perf_counter()
-                vals, idx = self._fns[b](
-                    self._U, self._V, self._item_pad_mask, u_dev
-                )
+                vals, idx = self._fns[b](*self._static_args, u_dev)
                 # force completion INSIDE the stage so async dispatch
                 # can't smear device time into the d2h readback below —
                 # and so the utilization accountant charges true device
@@ -319,9 +391,30 @@ class BucketedScorer:
                 if hot_lookups
                 else None,
             }
+            top = self.buckets[-1]
+            costs = self.devprof.costs()
+            top_cost = costs.get(top) or {}
+            flops = top_cost.get("flops")
+            nbytes = top_cost.get("bytes")
+            kernel = {
+                "backend": self.backend,
+                "factor_dtype": self.factor_dtype,
+                "resident_factor_bytes": self.resident_factor_bytes,
+                "block_items": (
+                    min(_score_kernel.BLOCK_I, self._n_items_pad)
+                    if self.backend == "fused" else None
+                ),
+                "warmup_executions": self.warmup_executions,
+                # top-rung arithmetic intensity: the roofline position the
+                # docs derive (docs/perf_roofline.md)
+                "intensity_flops_per_byte": (
+                    round(flops / nbytes, 3) if flops and nbytes else None
+                ),
+            }
             return {
                 "buckets": list(self.buckets),
                 "top_k": self.k,
+                "kernel": kernel,
                 "compile_count": self.compile_count,
                 "bucket_hits": {str(b): h for b, h in hits.items()},
                 "calls": sum(hits.values()),

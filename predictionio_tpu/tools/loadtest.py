@@ -124,6 +124,19 @@ def summarize_metrics(series: dict) -> dict:
             out["deviceHbmUtil"] = latest("pio_device_hbm_util")
     if ("pio_slow_trace_retained", ()) in series:
         out["slowTraces"] = total("pio_slow_trace_retained")
+    # score-kernel identity (ISSUE 9): which backend actually served this
+    # run and at what factor dtype — a fused-TPU loadtest that reports
+    # backend=reference means the dispatch seam fell back
+    for (name, labels), v in series.items():
+        if name == "pio_kernel_info" and v:
+            lbl = dict(labels)
+            out["kernelBackend"] = lbl.get("backend", "")
+            out["kernelFactorDtype"] = lbl.get("dtype", "")
+    if latest("pio_kernel_resident_factor_bytes") is not None:
+        out["kernelResidentFactorBytes"] = latest(
+            "pio_kernel_resident_factor_bytes"
+        )
+        out["kernelIntensity"] = latest("pio_kernel_intensity_flops_per_byte")
     for (name, labels), v in sorted(series.items()):
         if name.endswith("_breaker_state"):
             out.setdefault("breakerStates", {})[

@@ -179,6 +179,32 @@ HOTPATH_FIXTURE = {
         def _compile_scorer(model):
             return jax.jit(model)
     """,
+    # Pallas kernels: a bare-name kernel and a partial-specialised one
+    # (ops/score_kernel.py idiom) must both register as traced — the
+    # partial's bound keywords are static and branch-safe, while a host
+    # sync inside either kernel body must still fire.
+    "ops/kern.py": """\
+        from functools import partial
+
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _kern(x_ref, o_ref, *, block, flag):
+            if flag:
+                o_ref[...] = x_ref[...] * 2.0
+            else:
+                o_ref[...] = x_ref[...]
+
+        def _bad_partial_kern(x_ref, o_ref, *, block):
+            v = x_ref[...]
+            o_ref[...] = float(v)
+
+        def launch(x, shape):
+            pl.pallas_call(partial(_kern, block=8, flag=True),
+                           out_shape=shape)(x)
+            pl.pallas_call(partial(_bad_partial_kern, block=8),
+                           out_shape=shape)(x)
+    """,
 }
 
 
@@ -186,14 +212,18 @@ def test_hotpath_positives_and_negatives(tmp_path):
     root = make_repo(tmp_path, HOTPATH_FIXTURE)
     rep = run(root, analyzers=["hotpath"])
     assert symbols(rep, "hotpath-traced-branch") == {"bad_branch.x"}
-    assert symbols(rep, "hotpath-host-sync") == {"bad_sync.float"}
+    assert symbols(rep, "hotpath-host-sync") == {
+        "bad_sync.float", "_bad_partial_kern.float",
+    }
     assert symbols(rep, "hotpath-traced-loop") == {"bad_loop.xs"}
     assert symbols(rep, "hotpath-block-sync") == {"handle_query"}
     assert symbols(rep, "hotpath-jit-in-request") == {"recommend"}
-    # static args, shape checks, warmup fences, compile helpers: clean
+    # static args, shape checks, warmup fences, compile helpers, and
+    # partial-bound kernel keywords (branching on `flag`): clean
     all_syms = {f.symbol for f in rep.findings}
     assert not any("ok_static" in s or "ok_shape" in s or
                    "warmup" in s or "_compile" in s for s in all_syms)
+    assert not any(s.startswith("_kern.") for s in all_syms)
 
 
 # -- races --------------------------------------------------------------------

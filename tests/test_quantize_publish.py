@@ -1,0 +1,164 @@
+"""Quantized factor publish→deploy round-trip (ISSUE 9).
+
+At model publish ``CheckpointedALSModel.save`` may additionally seal a
+bf16/int8 factor variant (``quant.blob``, checksum envelope) — but only
+when its top-k overlap vs fp32 clears ``PIO_QUANT_MIN_OVERLAP``.  Deploy
+loads the variant device-resident and serves it through the quantized
+fastpath; a torn/corrupt blob, a dtype mismatch, or an explicit
+``PIO_QUANT_DTYPE=f32`` rollback all degrade to fp32 without failing the
+load (the fp32 factors are always kept).
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.models.als import ALSScorer, CheckpointedALSModel
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create()
+
+
+@pytest.fixture()
+def basedir(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    monkeypatch.delenv("PIO_QUANT_DTYPE", raising=False)
+    monkeypatch.delenv("PIO_QUANT_MIN_OVERLAP", raising=False)
+    return tmp_path
+
+
+def _model(n_users=60, n_items=40, rank=8, seed=3):
+    rng = np.random.default_rng(seed)
+    return CheckpointedALSModel(
+        rng.standard_normal((n_users, rank)).astype(np.float32),
+        rng.standard_normal((n_items, rank)).astype(np.float32),
+        BiMap.string_int(f"u{i}" for i in range(n_users)),
+        BiMap.string_int(f"i{i}" for i in range(n_items)),
+        None,
+    )
+
+
+def _quant_meta(instance_id):
+    with open(
+        os.path.join(CheckpointedALSModel._dir(instance_id), "maps.pkl"), "rb"
+    ) as f:
+        return pickle.load(f)["quant"]
+
+
+class TestPublish:
+    def test_int8_round_trip(self, ctx, basedir, monkeypatch):
+        monkeypatch.setenv("PIO_QUANT_DTYPE", "int8")
+        m = _model()
+        assert m.save("inst-rt", None)
+        d = CheckpointedALSModel._dir("inst-rt")
+        assert os.path.exists(os.path.join(d, "quant.blob"))
+        meta = _quant_meta("inst-rt")
+        assert meta["dtype"] == "int8"
+        assert meta["topk_overlap"] >= meta["threshold"]
+
+        m2 = CheckpointedALSModel.load("inst-rt", None, ctx)
+        assert m2.factor_dtype == "int8"
+        assert m2.user_factors_q.dtype == np.int8
+        assert m2.item_factors_q.dtype == np.int8
+        assert m2.user_scale.shape == (m.user_factors.shape[0], 1)
+        # fp32 factors ride along for exact scoring / rollback
+        np.testing.assert_array_equal(m2.user_factors, m.user_factors)
+
+    def test_default_publish_stays_f32(self, ctx, basedir):
+        m = _model()
+        m.save("inst-f32", None)
+        assert _quant_meta("inst-f32")["dtype"] == "f32"
+        d = CheckpointedALSModel._dir("inst-f32")
+        assert not os.path.exists(os.path.join(d, "quant.blob"))
+        m2 = CheckpointedALSModel.load("inst-f32", None, ctx)
+        assert m2.factor_dtype == "f32" and m2.user_factors_q is None
+
+    def test_below_threshold_refused(self, ctx, basedir, monkeypatch):
+        # an unreachable threshold: publish must refuse the variant and
+        # record the refusal, and serving must keep fp32
+        monkeypatch.setenv("PIO_QUANT_DTYPE", "int8")
+        monkeypatch.setenv("PIO_QUANT_MIN_OVERLAP", "1.01")
+        m = _model()
+        m.save("inst-refuse", None)
+        meta = _quant_meta("inst-refuse")
+        assert meta["dtype"] == "f32" and meta["refused"] == "int8"
+        d = CheckpointedALSModel._dir("inst-refuse")
+        assert not os.path.exists(os.path.join(d, "quant.blob"))
+        m2 = CheckpointedALSModel.load("inst-refuse", None, ctx)
+        assert m2.factor_dtype == "f32"
+
+
+class TestDeployDegradation:
+    def test_corrupt_blob_degrades_to_f32(self, ctx, basedir, monkeypatch):
+        monkeypatch.setenv("PIO_QUANT_DTYPE", "int8")
+        m = _model()
+        m.save("inst-corrupt", None)
+        blob = os.path.join(
+            CheckpointedALSModel._dir("inst-corrupt"), "quant.blob"
+        )
+        data = open(blob, "rb").read()
+        with open(blob, "wb") as f:
+            f.write(data[:-7] + b"XXXXXXX")
+        m2 = CheckpointedALSModel.load("inst-corrupt", None, ctx)
+        assert m2.factor_dtype == "f32" and m2.user_factors_q is None
+        np.testing.assert_array_equal(m2.user_factors, m.user_factors)
+
+    def test_missing_blob_degrades_to_f32(self, ctx, basedir, monkeypatch):
+        monkeypatch.setenv("PIO_QUANT_DTYPE", "int8")
+        m = _model()
+        m.save("inst-missing", None)
+        os.remove(
+            os.path.join(CheckpointedALSModel._dir("inst-missing"), "quant.blob")
+        )
+        m2 = CheckpointedALSModel.load("inst-missing", None, ctx)
+        assert m2.factor_dtype == "f32"
+
+    def test_explicit_f32_rollback(self, ctx, basedir, monkeypatch):
+        monkeypatch.setenv("PIO_QUANT_DTYPE", "int8")
+        m = _model()
+        m.save("inst-roll", None)
+        # operator rollback: PIO_QUANT_DTYPE=f32 at deploy ignores the
+        # sealed variant even though it is present and valid
+        monkeypatch.setenv("PIO_QUANT_DTYPE", "f32")
+        m2 = CheckpointedALSModel.load("inst-roll", None, ctx)
+        assert m2.factor_dtype == "f32" and m2.user_factors_q is None
+
+    def test_dtype_mismatch_degrades(self, ctx, basedir, monkeypatch):
+        monkeypatch.setenv("PIO_QUANT_DTYPE", "bf16")
+        m = _model()
+        m.save("inst-mismatch", None)
+        # artifact records bf16; a deploy pinned to int8 must not serve it
+        monkeypatch.setenv("PIO_QUANT_DTYPE", "int8")
+        m2 = CheckpointedALSModel.load("inst-mismatch", None, ctx)
+        assert m2.factor_dtype == "f32"
+
+
+class TestQuantizedServing:
+    def test_fastpath_serves_quantized_and_halves_bytes(
+        self, ctx, basedir, monkeypatch
+    ):
+        monkeypatch.setenv("PIO_QUANT_DTYPE", "int8")
+        m = _model()
+        m.save("inst-serve", None)
+        m2 = CheckpointedALSModel.load("inst-serve", None, ctx)
+        fp_q = ALSScorer(ctx, m2).enable_fastpath()
+        kern = fp_q.stats()["kernel"]
+        assert kern["factor_dtype"] == "int8"
+
+        fp_f = ALSScorer(ctx, _model()).enable_fastpath()
+        f32_bytes = fp_f.stats()["kernel"]["resident_factor_bytes"]
+        assert kern["resident_factor_bytes"] <= f32_bytes / 2
+
+        # quantized serving ranks like exact fp32 on well-separated rows
+        idx_q, _ = fp_q.score_topk(np.arange(10), 5)
+        idx_f, _ = fp_f.score_topk(np.arange(10), 5)
+        overlap = np.mean([
+            len(np.intersect1d(a, b)) / 5.0 for a, b in zip(idx_q, idx_f)
+        ])
+        assert overlap >= 0.9

@@ -1,0 +1,171 @@
+"""Equivalence suite: fused Pallas score kernel vs the XLA reference.
+
+The fused kernel (gather→dot→masked running top-k in one ``pallas_call``)
+must produce bit-identical *rankings* to the reference backend — indices
+exactly equal, including ``lax.top_k``'s ascending-index order among tied
+scores — with values allclose (the two backends may accumulate the dot
+product in different orders).  On the CPU test mesh the identical kernel
+runs in interpret mode via an explicit ``backend="fused"`` opt-in; the
+``auto`` selector must never pick the TPU kernel on CPU by itself.
+
+Property grid: batch rungs {1, 8, 16, 32, 64} × factor dtypes
+{f32, bf16, int8} × ragged item tails, plus duplicate-score ties,
+exclusion masks, and multi-block grids (items > block_items).
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import score_kernel
+from predictionio_tpu.ops.quantize import quantize_factors
+from predictionio_tpu.ops.topk import (
+    BACKENDS, gather_score_topk, resolve_backend,
+)
+
+RUNGS = (1, 8, 16, 32, 64)
+DTYPES = ("f32", "bf16", "int8")
+
+
+def _factors(n_users=50, n_items=40, rank=8, seed=0):
+    rng = np.random.default_rng(seed)
+    U = rng.standard_normal((n_users, rank)).astype(np.float32)
+    V = rng.standard_normal((n_items, rank)).astype(np.float32)
+    return U, V
+
+
+def _both(U, V, u_idx, k, dtype="f32", item_mask=None, seed_scale=None):
+    """(fused result, reference result) on identical quantized inputs."""
+    Uq, us = quantize_factors(U, dtype)
+    Vq, vs = quantize_factors(V, dtype)
+    kw = dict(item_mask=item_mask, u_scale=us, v_scale=vs)
+    fused = gather_score_topk(Uq, Vq, u_idx, k, backend="fused", **kw)
+    ref = gather_score_topk(Uq, Vq, u_idx, k, backend="reference", **kw)
+    return fused, ref
+
+
+def _assert_ranking_equal(fused, ref, dtype):
+    fv, fi = np.asarray(fused[0]), np.asarray(fused[1])
+    rv, ri = np.asarray(ref[0]), np.asarray(ref[1])
+    np.testing.assert_array_equal(
+        fi, ri, err_msg=f"[{dtype}] fused ranking differs from reference"
+    )
+    # values: same math, possibly different accumulation order — allclose,
+    # not bit-equal (documented tolerance; the *ranking* is the contract)
+    np.testing.assert_allclose(fv, rv, rtol=1e-5, atol=1e-5)
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("batch", RUNGS)
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_rungs_match_reference(self, batch, dtype):
+        U, V = _factors(seed=batch)
+        rng = np.random.default_rng(batch + 1)
+        u_idx = rng.integers(0, U.shape[0], batch).astype(np.int32)
+        fused, ref = _both(U, V, u_idx, 10, dtype=dtype)
+        _assert_ranking_equal(fused, ref, dtype)
+
+    @pytest.mark.parametrize("n_items", (1, 7, 29, 37))
+    def test_ragged_item_tail(self, n_items):
+        # non-multiple-of-8 catalogs: the kernel pads internally and the
+        # padded tail must never appear in the top-k
+        U, V = _factors(n_items=n_items, seed=n_items)
+        k = min(5, n_items)
+        u_idx = np.arange(min(8, U.shape[0]), dtype=np.int32)
+        fused, ref = _both(U, V, u_idx, k)
+        _assert_ranking_equal(fused, ref, "f32")
+        assert np.asarray(fused[1]).max() < n_items
+
+    def test_duplicate_score_ties_exact(self):
+        # identical item rows ⇒ exactly tied scores; both backends must
+        # break ties by ascending item index (lax.top_k semantics)
+        U, _ = _factors(seed=3)
+        rng = np.random.default_rng(4)
+        base = rng.standard_normal((5, 8)).astype(np.float32)
+        V = np.repeat(base, 6, axis=0)  # 30 items in 5 groups of 6 clones
+        u_idx = np.arange(8, dtype=np.int32)
+        fused, ref = _both(U, V, u_idx, 12)
+        _assert_ranking_equal(fused, ref, "f32-ties")
+
+    def test_exclusion_mask_never_wins(self):
+        U, V = _factors()
+        mask = np.zeros(V.shape[0], dtype=bool)
+        mask[::2] = True  # exclude every even item
+        u_idx = np.arange(16, dtype=np.int32)
+        fused, ref = _both(U, V, u_idx, 8, item_mask=mask)
+        _assert_ranking_equal(fused, ref, "f32-mask")
+        assert not np.any(np.asarray(fused[1]) % 2 == 0)
+
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_multi_block_grid(self, dtype):
+        # items > block_items forces multiple grid steps: the running
+        # top-k accumulator must merge across blocks, including a
+        # cross-block tie (item 3 cloned into the last block)
+        U, V = _factors(n_items=64, seed=9)
+        V[60] = V[3]
+        Uq, us = quantize_factors(U, dtype)
+        Vq, vs = quantize_factors(V, dtype)
+        u_idx = np.arange(8, dtype=np.int32)
+        fused = score_kernel.fused_gather_score_topk(
+            Uq, Vq, u_idx, 10, u_scale=us, v_scale=vs, block_items=16
+        )
+        ref = gather_score_topk(
+            Uq, Vq, u_idx, 10, backend="reference", u_scale=us, v_scale=vs
+        )
+        _assert_ranking_equal(fused, ref, dtype)
+
+    def test_k_equals_items(self):
+        U, V = _factors(n_items=12)
+        u_idx = np.arange(4, dtype=np.int32)
+        fused, ref = _both(U, V, u_idx, 12)
+        _assert_ranking_equal(fused, ref, "f32-fullk")
+
+
+class TestBackendResolution:
+    def test_auto_never_fused_on_cpu(self):
+        # the CPU test mesh: auto must fall back to the reference path,
+        # not silently run the TPU kernel through the interpreter
+        import jax
+
+        if jax.default_backend() != "tpu":
+            assert resolve_backend("auto") == "reference"
+            assert resolve_backend(None) == "reference"
+
+    def test_env_selector(self, monkeypatch):
+        monkeypatch.setenv("PIO_SCORE_KERNEL", "fused")
+        assert resolve_backend() == "fused"
+        monkeypatch.setenv("PIO_SCORE_KERNEL", "reference")
+        assert resolve_backend() == "reference"
+
+    def test_explicit_argument_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_SCORE_KERNEL", "reference")
+        assert resolve_backend("fused") == "fused"
+
+    def test_pio_native_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("PIO_NATIVE", "0")
+        assert resolve_backend("fused") == "reference"
+
+    def test_invalid_backend_raises(self):
+        with pytest.raises(ValueError, match="PIO_SCORE_KERNEL"):
+            resolve_backend("vectorized")
+        assert set(BACKENDS) == {"fused", "reference", "auto"}
+
+
+class TestQuantize:
+    def test_int8_round_trip_error_bounded(self):
+        U, _ = _factors()
+        q, scale = quantize_factors(U, "int8")
+        assert q.dtype == np.int8 and scale.dtype == np.float32
+        back = q.astype(np.float32) * scale
+        # per-row max error ≤ half a quantization step
+        step = np.abs(U).max(axis=1, keepdims=True) / 127.0
+        assert np.all(np.abs(back - U) <= step / 2 + 1e-7)
+
+    def test_zero_row_is_stable(self):
+        Z = np.zeros((3, 8), dtype=np.float32)
+        q, scale = quantize_factors(Z, "int8")
+        assert np.all(q == 0) and np.all(np.isfinite(scale))
+
+    def test_f32_passthrough(self):
+        U, _ = _factors()
+        q, scale = quantize_factors(U, "f32")
+        assert q is U and scale is None

@@ -32,7 +32,8 @@ OUT = os.path.join(REPO, "BENCH_TPU_MANUAL.json")
 # extras (serving latency, solver A/B, measured utilization).
 _PIN = {"BENCH_REBALANCE": "1", "BENCH_DTYPE": "f32"}
 _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
-         "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0"}
+         "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0",
+         "BENCH_KERNEL": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -187,6 +188,21 @@ def main() -> int:
             for k in ("busy_fraction", "flops_per_s", "mfu")
         ),
     }
+    # score-kernel gate (ISSUE 9): the fused Pallas kernel must sit at or
+    # above the XLA reference — on the analytic intensity model always,
+    # and on measured scores/s when the cell ran on silicon — and the
+    # int8 factor variant must at least halve the resident footprint
+    kern = primary.get("kernel") or {}
+    f32_cell = (kern.get("dtypes") or {}).get("f32") or {}
+    artifact["kernel"] = {
+        "intensity_gain_f32": kern.get("intensity_gain_f32"),
+        "int8_resident_vs_f32": kern.get("int8_resident_vs_f32"),
+        "measured_gain_f32": f32_cell.get("measured_gain"),
+        "measured_scores_per_sec_f32": f32_cell.get(
+            "measured_scores_per_sec"
+        ),
+        "gate_pass": kern.get("gate_pass"),
+    }
     # static-analysis gate: perf numbers from a repo carrying hot-path or
     # race hazards are not publishable — `pio analyze` must report zero
     # errors for the matrix to count
@@ -222,6 +238,7 @@ def main() -> int:
         "durability": artifact["durability"],
         "observability": artifact["observability"],
         "serving_utilization": artifact["serving_utilization"],
+        "kernel": artifact["kernel"],
         "analysis": artifact["analysis"],
     }))
     return 0 if all_tpu else 1
