@@ -1068,6 +1068,270 @@ def _kernel_bench(platform: str, n_items: int, rank: int) -> dict:
     return out
 
 
+_FLEET_CHILD = """
+import os
+from predictionio_tpu.data import store as store_mod
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.serving.query_server import QueryServer
+from predictionio_tpu.templates.recommendation import RecommendationEngine
+
+storage = Storage()
+store_mod.set_storage(storage)
+qs = QueryServer(
+    RecommendationEngine.apply(), storage=storage,
+    ctx=MeshContext.create(), telemetry=False,
+)
+qs.start("127.0.0.1", int(os.environ["FLEET_CHILD_PORT"]))
+qs.service.serve_forever()
+"""
+
+
+def _fleet_bench(ctx) -> dict:
+    """Fleet routing evidence (ISSUE 10): replica scaling (1 vs 3 replica
+    qps through the router), hedged vs unhedged p99 with one injected
+    slow replica, and a rolling deploy under load.
+
+    The two acceptance numbers are ``hedged_vs_unhedged_p99`` — the hedge
+    must at least halve the slow-replica tail — and
+    ``roll.client_errors`` — a roll must be invisible to clients (zero
+    non-200s).  The slow replica is made slow via the seeded fault shim
+    in its own process (``PIO_FAULT_SPEC`` latency on the query path), so
+    /readyz stays green and the routers see a wedged-but-listening
+    replica, not a dead one.
+    """
+    import shutil
+    import socket
+    import tempfile
+    import threading
+    import urllib.request
+
+    import predictionio_tpu
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import Event
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.data.storage.sqlite import close_db
+    from predictionio_tpu.serving.fleet import FleetSupervisor
+    from predictionio_tpu.serving.router import ADMITTED, Router
+    from predictionio_tpu.templates.recommendation import (
+        RecommendationEngine,
+    )
+    from predictionio_tpu.tools.loadtest import run_loadtest
+
+    n_req = int(os.environ.get("BENCH_FLEET_REQUESTS", 200))
+    slow_ms = float(os.environ.get("BENCH_FLEET_SLOW_MS", 250.0))
+    slow_p = float(os.environ.get("BENCH_FLEET_SLOW_P", 0.1))
+    tmp = tempfile.mkdtemp(prefix="pio-fleet-bench-")
+    src = "FLEETB"
+    storage_env = {
+        f"PIO_STORAGE_SOURCES_{src}_TYPE": "sqlite",
+        f"PIO_STORAGE_SOURCES_{src}_PATH": os.path.join(
+            tmp, "events.sqlite"
+        ),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+    }
+    old_basedir = os.environ.get("PIO_FS_BASEDIR")
+    os.environ["PIO_FS_BASEDIR"] = os.path.join(tmp, "fs")
+    routers: list = []
+    fleets: list = []
+    out: dict = {}
+    try:
+        storage = Storage(env=storage_env)
+        store_mod.set_storage(storage)
+        app_id = storage.get_meta_data_apps().insert(App(0, "fleetbench"))
+        le = storage.get_l_events()
+        le.init(app_id)
+        rng = np.random.default_rng(23)
+        events = []
+        for u in range(20):
+            for i in rng.choice(16, size=6, replace=False):
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties={"rating": float(rng.integers(1, 6))},
+                ))
+        le.batch_insert(events, app_id)
+        engine = RecommendationEngine.apply()
+        ep = engine.params_from_variant({
+            "datasource": {"params": {"appName": "fleetbench"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 3}}
+            ],
+        })
+        run_train(engine, ep, "f", storage=storage, ctx=ctx)
+
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(predictionio_tpu.__file__))
+        )
+        child_env = dict(os.environ)
+        child_env.pop("PIO_FAULT_SPEC", None)
+        child_env.update(storage_env)
+        child_env["JAX_PLATFORMS"] = "cpu"
+        child_env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root] + ([child_env["PYTHONPATH"]]
+                           if child_env.get("PYTHONPATH") else [])
+        )
+
+        def spawn_with(extra):
+            def spawn(port):
+                cenv = dict(child_env)
+                cenv.update(extra)
+                cenv["FLEET_CHILD_PORT"] = str(port)
+                return subprocess.Popen(
+                    [sys.executable, "-c", _FLEET_CHILD], env=cenv,
+                )
+            return spawn
+
+        socks = [socket.socket() for _ in range(4)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+        fast_ports, slow_port = ports[:3], ports[3]
+        fleet = FleetSupervisor(spawn_with({}), fast_ports)
+        slow_spec = (
+            f"site=server:queryserver:/queries.json,kind=latency,"
+            f"latency_ms={slow_ms:g},p={slow_p:g}"
+        )
+        slow_fleet = FleetSupervisor(
+            spawn_with({"PIO_FAULT_SPEC": slow_spec}), [slow_port]
+        )
+        fleets = [fleet, slow_fleet]
+        fleet.start()
+        slow_fleet.start()
+        fast_urls = fleet.urls()
+        slow_url = slow_fleet.urls()[0]
+
+        def mk_router(urls, hedge):
+            r = Router(urls, hedge_enabled=hedge, telemetry=False)
+            r.health_interval_ms = 100.0
+            r.outlier_ratio = 1e9  # isolate hedging from outlier ejection
+            routers.append(r)
+            port = r.start("127.0.0.1", 0)
+            return r, f"http://127.0.0.1:{port}"
+
+        def wait_proven(r, timeout=180.0):
+            t_end = time.time() + timeout
+            while time.time() < t_end:
+                reps = r.stats()["replicas"]
+                if all(x["state"] == ADMITTED
+                       and x["generation"] is not None for x in reps):
+                    return
+                time.sleep(0.1)
+            raise TimeoutError("fleet bench replicas never became ready")
+
+        users = [f"u{i}" for i in range(20)]
+
+        def measure(base):
+            # run_loadtest appends /queries.json itself
+            return run_loadtest(
+                base, {"user": "u1", "num": 3},
+                requests=n_req, concurrency=8, samples={"user": users},
+            )
+
+        r1, b1 = mk_router([fast_urls[0]], hedge=False)
+        r3, b3 = mk_router(list(fast_urls), hedge=False)
+        mixed = [fast_urls[0], fast_urls[1], slow_url]
+        ru, bu = mk_router(mixed, hedge=False)
+        rh, bh = mk_router(mixed, hedge=True)
+        for r in (r1, r3, ru, rh):
+            wait_proven(r)
+
+        one = measure(b1)
+        three = measure(b3)
+        out["qps_1_replica"] = one["qps"]
+        out["qps_3_replicas"] = three["qps"]
+        out["scaling_3_over_1"] = (
+            round(three["qps"] / one["qps"], 3) if one["qps"] else None
+        )
+        unhedged = measure(bu)
+        hedged = measure(bh)
+        out["p99_unhedged_slow_ms"] = unhedged["p99Ms"]
+        out["p99_hedged_ms"] = hedged["p99Ms"]
+        out["p50_unhedged_slow_ms"] = unhedged["p50Ms"]
+        out["p50_hedged_ms"] = hedged["p50Ms"]
+        out["hedged_vs_unhedged_p99"] = (
+            round(hedged["p99Ms"] / unhedged["p99Ms"], 4)
+            if unhedged["p99Ms"] else None
+        )
+        out["hedges"] = {
+            "fired": rh.counters.get("hedges_fired"),
+            "won": rh.counters.get("hedges_won"),
+            "denied": rh.counters.get("hedges_denied"),
+            "delay_ms": round(rh.hedge_delay_ms(), 1),
+        }
+        out["load_errors"] = (
+            one["errors"] + three["errors"]
+            + unhedged["errors"] + hedged["errors"]
+        )
+
+        # rolling deploy under load: retrain, roll the 3-replica fleet
+        # through r3, count every client-visible non-200
+        run_train(engine, ep, "f", storage=storage, ctx=ctx)
+        fleet.router = r3
+        r3.attach_fleet(fleet)
+        stop_evt = threading.Event()
+        lock = threading.Lock()
+        tally = {"ok": 0, "errors": 0}
+
+        def pound(idx):
+            i = 0
+            while not stop_evt.is_set():
+                body = json.dumps(
+                    {"user": f"u{(i * 7 + idx) % 20}", "num": 3}
+                ).encode()
+                req = urllib.request.Request(
+                    b3 + "/queries.json", data=body, method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        resp.read()
+                        ok = resp.status == 200
+                except Exception:
+                    ok = False
+                with lock:
+                    tally["ok" if ok else "errors"] += 1
+                i += 1
+
+        workers = [
+            threading.Thread(target=pound, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for w in workers:
+            w.start()
+        t0 = time.time()
+        report = fleet.roll()
+        wall = time.time() - t0
+        stop_evt.set()
+        for w in workers:
+            w.join(30.0)
+        out["roll"] = {
+            "wall_sec": round(wall, 1),
+            "ok": tally["ok"],
+            "client_errors": tally["errors"],
+            "replicas_ok": report["ok"],
+        }
+    finally:
+        for r in routers:
+            r.stop()
+        for f in fleets:
+            f.stop()
+        store_mod.set_storage(None)
+        close_db(os.path.join(tmp, "events.sqlite"))
+        if old_basedir is None:
+            os.environ.pop("PIO_FS_BASEDIR", None)
+        else:
+            os.environ["PIO_FS_BASEDIR"] = old_basedir
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main() -> None:
     # BENCH_PLATFORM=cpu skips the (slow) tunnel probe for local iteration
     forced_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
@@ -1255,6 +1519,14 @@ def main() -> None:
             print(f"WARNING: kernel bench failed: {e}", file=sys.stderr)
             kernel = {"error": str(e)}
         print(f"INFO: kernel: {kernel}", file=sys.stderr)
+    fleet = None
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        try:
+            fleet = _fleet_bench(ctx)
+        except Exception as e:  # the fleet bench must never kill the artifact
+            print(f"WARNING: fleet bench failed: {e}", file=sys.stderr)
+            fleet = {"error": str(e)}
+        print(f"INFO: fleet: {fleet}", file=sys.stderr)
     record = {
         "metric": "als_train_events_per_sec_per_chip",
         "value": round(value, 1),
@@ -1293,6 +1565,8 @@ def main() -> None:
         record["observability"] = observability
     if kernel is not None:
         record["kernel"] = kernel
+    if fleet is not None:
+        record["fleet"] = fleet
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

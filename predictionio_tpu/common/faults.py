@@ -11,6 +11,10 @@ SEEDED schedule, at named fault SITES compiled into the service planes:
   before each HTTP call (latency / simulated drop / simulated 5xx).
 * ``client:storage:frames:<path>`` — consulted per frame of a framed bulk
   pull (truncation mid-stream).
+* ``client:router:<path>`` — consulted by the fleet router
+  (``serving/router.py``) before each forward on the router→replica hop
+  (latency / simulated drop / simulated 5xx exercise the hedge + retry
+  machinery without touching any replica).
 * ``crash:<subsystem>:<point>`` — consulted by :func:`crash_point` calls
   compiled into durability-critical code paths (e.g.
   ``crash:ingest:before_flush_commit``, ``crash:modeldata:mid_write``).

@@ -468,7 +468,13 @@ class EventServer:
             # readiness: a draining server tells the balancer to route away
             # while in-flight work finishes
             if self._draining:
-                return json_response(503, {"status": "draining"})
+                # carries Retry-After like every other 503 shed path —
+                # docs/operations.md promises the header on all of them
+                return Response(
+                    status=503,
+                    body={"status": "draining"},
+                    headers={"Retry-After": "1"},
+                )
             return json_response(200, {"status": "ready"})
 
         @svc.route("POST", r"/stop")

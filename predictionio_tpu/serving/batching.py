@@ -365,6 +365,13 @@ class MicroBatcher:
                     self._inflight_keys[p.key] = promoted
                 else:
                     del self._inflight_keys[p.key]
+        if promoted is not None and promoted.trace is not None:
+            # flight-recorder: this request entered as a follower and took
+            # over an abandoned leader's batch slot — `coalesce` flips to
+            # "leader" at dispatch, `promoted` records why (the routing
+            # tier hedges leaders away; the invariant test pins that the
+            # device is still charged exactly once, to the promoted trace)
+            promoted.trace.annotate(promoted=True)
         err = DeadlineExceeded("query deadline expired in queue")
         for waiter in [p, *dead]:
             waiter.result = None

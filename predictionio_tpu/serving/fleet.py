@@ -1,0 +1,284 @@
+"""Fleet supervisor: replica subprocess lifecycle + zero-downtime rolls.
+
+The :class:`~predictionio_tpu.serving.router.Router` decides where
+traffic goes; this module decides which processes exist.  It spawns N
+query-server replica subprocesses, restarts crashed ones with
+exponential backoff (reset after a healthy period), and orchestrates
+**rolling deploys**: one replica at a time it
+
+1. marks the replica draining at the ROUTER (traffic routes away
+   first — the replica's own shed path is only the safety net),
+2. drains the process via the PR 5 ``POST /stop`` path,
+3. restarts it — the new process deploys the latest COMPLETED model
+   generation through the unchanged atomic-publish/LKG machinery,
+4. verifies ``GET /readyz`` answers 200 **and warm**
+   (``fastpathWarm``), and
+5. re-opens the replica at the router (readmission still goes through
+   the health gate + slow start), then moves on.
+
+``pio deploy --fleet N`` builds one of these around child ``pio
+deploy`` processes; ``pio fleet roll`` triggers ``roll()`` through the
+router's ``POST /fleet/roll``.
+
+The supervisor is process-management only: it never sits on the query
+path.  Spawning is delegated to a ``spawn_fn(port) -> subprocess.Popen``
+so tests can run replicas from a ``python -c`` script and the CLI can
+re-exec itself.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _env_num(name: str, default, cast):
+    try:
+        return cast(os.environ[name])
+    except (KeyError, ValueError, TypeError):
+        return default
+
+
+class ReplicaProc:
+    """One supervised replica slot.  Fields guarded by the supervisor's
+    ``_lock``."""
+
+    def __init__(self, port: int, url: str):
+        self.port = port
+        self.url = url
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self.backoff_s = 0.0
+        self.next_restart_at = 0.0
+        self.started_at = 0.0
+        self.expected_down = False  # a roll is restarting it on purpose
+
+
+class FleetSupervisor:
+    """Spawn/respawn N replica subprocesses; orchestrate rolling deploys."""
+
+    def __init__(
+        self,
+        spawn_fn: Callable[[int], subprocess.Popen],
+        ports: list[int],
+        host: str = "127.0.0.1",
+        router=None,
+    ):
+        self.spawn_fn = spawn_fn
+        self.host = host
+        self.router = router
+        self._procs = [
+            ReplicaProc(p, f"http://{host}:{p}") for p in ports
+        ]
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._monitor_thread: Optional[threading.Thread] = None
+        self.restart_backoff_s = _env_num(
+            "PIO_FLEET_RESTART_BACKOFF_S", 0.5, float
+        )
+        self.restart_backoff_max_s = _env_num(
+            "PIO_FLEET_RESTART_BACKOFF_MAX_S", 10.0, float
+        )
+        self.stop_timeout_s = _env_num("PIO_FLEET_STOP_TIMEOUT_S", 10.0, float)
+        self.roll_timeout_s = _env_num("PIO_FLEET_ROLL_TIMEOUT_S", 60.0, float)
+
+    def urls(self) -> list[str]:
+        return [rp.url for rp in self._procs]
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            for rp in self._procs:
+                self._spawn_locked(rp)
+            self._monitor_thread = threading.Thread(
+                target=self._monitor_loop, name="fleet-monitor", daemon=True
+            )
+        self._monitor_thread.start()
+
+    def _spawn_locked(self, rp: ReplicaProc) -> None:
+        rp.proc = self.spawn_fn(rp.port)
+        rp.started_at = time.monotonic()
+        rp.expected_down = False
+        logger.info(
+            "fleet: replica on port %d spawned (pid %s)",
+            rp.port, rp.proc.pid,
+        )
+
+    def _monitor_loop(self):
+        while not self._stop_evt.wait(0.25):
+            self._check_children()
+
+    def _check_children(self) -> None:
+        """Restart crashed replicas with exponential backoff; a replica
+        that stayed up past its backoff window resets to the base."""
+        now = time.monotonic()
+        with self._lock:
+            for rp in self._procs:
+                if rp.proc is None or rp.expected_down:
+                    continue
+                if rp.proc.poll() is None:
+                    # alive: a healthy stretch forgives past crashes
+                    if (
+                        rp.backoff_s
+                        and now - rp.started_at > self.restart_backoff_max_s
+                    ):
+                        rp.backoff_s = 0.0
+                    continue
+                if rp.next_restart_at == 0.0:
+                    # first observation of this crash: restart after the
+                    # current backoff (0 after a healthy run), then double
+                    # it for the next crash
+                    delay = rp.backoff_s
+                    rp.backoff_s = min(
+                        max(rp.backoff_s * 2, self.restart_backoff_s),
+                        self.restart_backoff_max_s,
+                    )
+                    rp.next_restart_at = now + delay
+                    logger.warning(
+                        "fleet: replica on port %d exited rc=%s; restart "
+                        "in %.1fs", rp.port, rp.proc.returncode, delay,
+                    )
+                if now >= rp.next_restart_at:
+                    rp.restarts += 1
+                    rp.next_restart_at = 0.0
+                    self._spawn_locked(rp)
+
+    # -- rolling deploy ------------------------------------------------------
+    def roll(self) -> dict:
+        """Drain → restart → verify each replica in sequence.  Returns a
+        per-replica report; raises nothing (a failed replica is reported
+        and the roll continues — partial fleets beat dead rolls)."""
+        report = []
+        for rp in self._procs:
+            entry = {"port": rp.port, "url": rp.url}
+            try:
+                self._roll_one(rp)
+                entry["ok"] = True
+            except Exception as e:
+                entry["ok"] = False
+                entry["error"] = f"{type(e).__name__}: {e}"
+                logger.exception(
+                    "fleet roll: replica on port %d failed", rp.port
+                )
+            report.append(entry)
+        return {"replicas": report, "ok": all(e["ok"] for e in report)}
+
+    def _roll_one(self, rp: ReplicaProc) -> None:
+        deadline = time.monotonic() + self.roll_timeout_s
+        if self.router is not None:
+            self.router.set_replica_draining(rp.url, True)
+        with self._lock:
+            rp.expected_down = True
+            proc = rp.proc
+        try:
+            if proc is not None and proc.poll() is None:
+                self._post_stop(rp.url)
+                try:
+                    proc.wait(timeout=self.stop_timeout_s)
+                except subprocess.TimeoutExpired:
+                    logger.warning(
+                        "fleet roll: replica on port %d ignored drain; "
+                        "killing", rp.port,
+                    )
+                    proc.kill()
+                    proc.wait(timeout=5)
+            with self._lock:
+                self._spawn_locked(rp)
+            self._wait_ready(rp.url, deadline)
+        finally:
+            with self._lock:
+                rp.expected_down = False
+            if self.router is not None:
+                self.router.set_replica_draining(rp.url, False)
+        if self.router is not None:
+            self._wait_admitted(rp.url, deadline)
+
+    def _post_stop(self, url: str) -> None:
+        try:
+            with urllib.request.urlopen(
+                urllib.request.Request(url + "/stop", method="POST"),
+                timeout=5,
+            ) as r:
+                r.read()
+        except OSError:
+            # the process may tear the socket down mid-response, or be
+            # dead already — either way the wait() below decides
+            pass
+
+    def _wait_ready(self, url: str, deadline: float) -> None:
+        """Poll /readyz until 200 + warm; raise on timeout."""
+        last = "no probe yet"
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(url + "/readyz", timeout=2) as r:
+                    info = json.loads(r.read().decode("utf-8"))
+                if info.get("fastpathWarm", True):
+                    return
+                last = "ready but not warm"
+            except urllib.error.HTTPError as e:
+                last = f"readyz {e.code}"
+            except (OSError, ValueError) as e:
+                last = f"{type(e).__name__}: {e}"
+            time.sleep(0.1)
+        raise TimeoutError(f"replica {url} never became ready ({last})")
+
+    def _wait_admitted(self, url: str, deadline: float) -> None:
+        """Wait for the router's health gate to readmit the replica so the
+        fleet is back to full strength before the next one drains."""
+        url = url.rstrip("/")
+        while time.monotonic() < deadline:
+            for rep in self.router.stats()["replicas"]:
+                if rep["url"] == url and rep["state"] == "admitted":
+                    return
+            time.sleep(0.05)
+        logger.warning(
+            "fleet roll: %s not readmitted inside the roll budget", url
+        )
+
+    # -- status / shutdown ---------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "replicas": [
+                    {
+                        "port": rp.port,
+                        "url": rp.url,
+                        "pid": rp.proc.pid if rp.proc else None,
+                        "alive": (
+                            rp.proc is not None and rp.proc.poll() is None
+                        ),
+                        "restarts": rp.restarts,
+                        "rolling": rp.expected_down,
+                    }
+                    for rp in self._procs
+                ]
+            }
+
+    def stop(self) -> None:
+        """Stop supervising and tear the children down (drain first,
+        then kill what lingers)."""
+        self._stop_evt.set()
+        with self._lock:
+            procs = [rp.proc for rp in self._procs if rp.proc is not None]
+            for rp in self._procs:
+                rp.expected_down = True
+        for rp in self._procs:
+            self._post_stop(rp.url)
+        for proc in procs:
+            try:
+                proc.wait(timeout=self.stop_timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass  # unkillable (D-state); nothing more to do

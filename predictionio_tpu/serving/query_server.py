@@ -221,6 +221,11 @@ class QueryServer:
         self._warm_fastpath = (
             batching if warm_fastpath is None else bool(warm_fastpath)
         )
+        # /readyz reports whether the LIVE generation actually finished its
+        # warmup compiles (routers gate admission on *warm*, not merely
+        # *loaded*).  True when warmup is not configured: a server that never
+        # warms is as warm as it will ever be.
+        self._fastpath_warm = not self._warm_fastpath
         # skew hot path (ISSUE 6): result cache for identical queries +
         # single-flight coalescing at the batcher.  Both default from env
         # knobs (PIO_RESULT_CACHE / PIO_COALESCE, off-by-default-safe);
@@ -298,9 +303,11 @@ class QueryServer:
             if fallback is None:
                 raise  # truly nothing deployable
             return fallback.instance_id
+        warm_ok = not self._warm_fastpath
         if self._warm_fastpath:
             # pre-compile the serving fast path at deploy/reload so no live
             # request ever pays trace/compile latency (ISSUE: AOT warmup)
+            warm_ok = True
             for algo, model in zip(algorithms, models):
                 warm = getattr(algo, "warmup", None)
                 if warm is None:
@@ -308,6 +315,7 @@ class QueryServer:
                 try:
                     warm(model)
                 except Exception:
+                    warm_ok = False
                     self.counters.inc("warmup_errors")
                     self._rl_log.exception(
                         "warmup", "fastpath warmup failed for %s",
@@ -322,6 +330,7 @@ class QueryServer:
         )
         with self._lock:
             self._deployed = deployed
+            self._fastpath_warm = warm_ok
         self._note_generation_swap()
         with self._lock:
             self._reload_degraded = False
@@ -409,6 +418,8 @@ class QueryServer:
             )
             with self._lock:
                 self._deployed = deployed
+                # the fallback path deploys without running warmup
+                self._fastpath_warm = not self._warm_fastpath
             self._note_generation_swap()
             self.counters.inc("reload_failed")
             with self._lock:
@@ -861,6 +872,8 @@ class QueryServer:
             # generation is still serving.
             with self._lock:
                 deployed = self._deployed is not None
+                generation = self._serving_gen
+                warm = self._fastpath_warm
             with self._inflight_lock:
                 inflight = self._inflight
             body = {
@@ -869,16 +882,24 @@ class QueryServer:
                 "maxInflight": self.max_inflight,
                 "reloadDegraded": self._reload_degraded,
                 "draining": self._draining,
+                # router admission context: which model generation is live
+                # and whether its warmup compiles completed — balancers gate
+                # on *warm*, not merely *loaded*
+                "generation": generation,
+                "fastpathWarm": warm,
             }
+            # every not-ready answer carries Retry-After, as the shed paths
+            # do — docs/operations.md promises the header on all 503s
+            retry = {"Retry-After": f"{self.shed_retry_after_s:g}"}
             if self._draining:
                 body["status"] = "draining"
-                return json_response(503, body)
+                return Response(status=503, body=body, headers=retry)
             if not deployed:
                 body["status"] = "no engine instance deployed"
-                return json_response(503, body)
+                return Response(status=503, body=body, headers=retry)
             if inflight >= self.max_inflight:
                 body["status"] = "overloaded"
-                return json_response(503, body)
+                return Response(status=503, body=body, headers=retry)
             body["status"] = "ready"
             return json_response(200, body)
 

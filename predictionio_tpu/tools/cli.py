@@ -352,9 +352,69 @@ def _install_drain_handler(server) -> None:
         pass  # not the main thread (embedded use): skip
 
 
+def _child_deploy_argv(args, port: int) -> list[str]:
+    """Re-exec this CLI as a single-replica ``deploy`` child on ``port``
+    (fleet mode: the parent becomes the router, children do the serving)."""
+    argv = [
+        sys.executable, "-m", "predictionio_tpu.tools.cli", "deploy",
+        "--ip", "127.0.0.1", "--port", str(port),
+    ]
+    if getattr(args, "engine_dir", None):
+        argv += ["--engine-dir", args.engine_dir]
+    if getattr(args, "variant", None):
+        argv += ["--variant", args.variant]
+    if args.feedback:
+        argv += [
+            "--feedback",
+            "--event-server-ip", args.event_server_ip,
+            "--event-server-port", str(args.event_server_port),
+        ]
+    if args.accesskey:
+        argv += ["--accesskey", args.accesskey]
+    for p in args.plugin:
+        argv += ["--plugin", p]
+    if args.batching:
+        argv += ["--batching"]
+    return argv
+
+
+def _deploy_fleet(args) -> int:
+    """``pio deploy --fleet N``: N replica subprocesses on ports
+    port+1..port+N behind a health-checked, hedging router on ``port``,
+    supervised for crash-restart and rolling deploys."""
+    import subprocess
+
+    from predictionio_tpu.serving.fleet import FleetSupervisor
+    from predictionio_tpu.serving.router import Router
+
+    ports = [args.port + 1 + i for i in range(args.fleet)]
+
+    def spawn(port: int) -> subprocess.Popen:
+        return subprocess.Popen(_child_deploy_argv(args, port))
+
+    router = Router([f"http://127.0.0.1:{p}" for p in ports])
+    fleet = FleetSupervisor(spawn, ports, router=router)
+    router.attach_fleet(fleet)
+    fleet.start()
+    port = router.start(args.ip, args.port)
+    _install_drain_handler(router)
+    print(
+        f"[INFO] Fleet of {args.fleet} replicas (ports "
+        f"{ports[0]}-{ports[-1]}) is deploying behind the router at "
+        f"http://{args.ip}:{port}. Roll with `pio fleet roll`."
+    )
+    try:
+        router.service.serve_forever()
+    except KeyboardInterrupt:
+        router.shutdown()
+    return 0
+
+
 def cmd_deploy(args) -> int:
     from predictionio_tpu.serving.query_server import QueryServer
 
+    if getattr(args, "fleet", 0) and args.fleet > 1:
+        return _deploy_fleet(args)
     variant = load_variant(args)
     engine = resolve_engine_from_variant(variant)
     engine_id, engine_version, engine_variant = engine_identity(variant)
@@ -385,6 +445,43 @@ def cmd_deploy(args) -> int:
     except KeyboardInterrupt:
         qs.drain()
     return 0
+
+
+def cmd_fleet(args) -> int:
+    """Operate a running fleet router: ``status`` prints the replica
+    table; ``roll`` triggers a zero-downtime rolling deploy and waits
+    for it to finish."""
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    base = f"http://{args.ip}:{args.port}"
+
+    def get_fleet() -> dict:
+        with urllib.request.urlopen(base + "/fleet", timeout=10) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    try:
+        if args.fleet_command == "status":
+            print(json.dumps(get_fleet(), indent=2))
+            return 0
+        # roll
+        req = urllib.request.Request(base + "/fleet/roll", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            print(f"[INFO] {json.loads(r.read().decode())['message']}")
+        deadline = _time.monotonic() + args.timeout
+        while _time.monotonic() < deadline:
+            state = get_fleet()
+            if not state.get("rolling"):
+                print(json.dumps(state, indent=2))
+                print("[INFO] Roll complete.")
+                return 0
+            _time.sleep(0.5)
+        return _die(f"roll still in progress after {args.timeout}s")
+    except urllib.error.HTTPError as e:
+        return _die(f"router answered {e.code}: {e.read().decode()}")
+    except OSError as e:
+        return _die(f"no router at {base}: {e}")
 
 
 def cmd_undeploy(args) -> int:
@@ -941,7 +1038,30 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--key-path", default=None)
     sp.add_argument("--batching", action="store_true",
                     help="micro-batch concurrent queries into one device pass")
+    sp.add_argument(
+        "--fleet", type=int, default=0, metavar="N",
+        help="serve N replica subprocesses (ports PORT+1..PORT+N) behind "
+        "a health-checked, hedging router on PORT",
+    )
     sp.set_defaults(func=cmd_deploy)
+
+    sp = sub.add_parser(
+        "fleet", help="operate a running fleet router (status / roll)"
+    )
+    fleet_sub = sp.add_subparsers(dest="fleet_command", required=True)
+    x = fleet_sub.add_parser("status")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=8000)
+    x.set_defaults(func=cmd_fleet)
+    x = fleet_sub.add_parser(
+        "roll", help="zero-downtime rolling deploy to the latest "
+        "trained model generation",
+    )
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=8000)
+    x.add_argument("--timeout", type=float, default=600.0,
+                   help="seconds to wait for the roll to finish")
+    x.set_defaults(func=cmd_fleet)
 
     sp = sub.add_parser("undeploy")
     sp.add_argument("--ip", default="127.0.0.1")

@@ -12,6 +12,7 @@ import time
 
 import pytest
 
+from predictionio_tpu.common.resilience import Deadline, DeadlineExceeded
 from predictionio_tpu.obs import devprof
 from predictionio_tpu.obs import tracing as obs_tracing
 from predictionio_tpu.obs.tracing import Trace, Tracer
@@ -339,3 +340,95 @@ class TestDeviceChargedOncePerDispatch:
         finally:
             release.set()
             mb.stop()
+
+    def test_promoted_follower_charged_once_leader_charged_never(self):
+        """A leader hedged away (deadline lapsed in queue, e.g. because the
+        router's hedge already answered elsewhere) must not be charged for
+        device stages — the promoted follower takes the batch slot and the
+        device bill, exactly once, with ``promoted=True`` recording why."""
+        started = threading.Event()
+        release = threading.Event()
+        calls = []
+
+        def run_batch(queries):
+            calls.append(list(queries))
+            if len(calls) == 1:
+                # first dispatch: an unrelated blocker that pins the worker
+                # so the keyed leader stays queued past its deadline
+                started.set()
+                assert release.wait(5.0)
+            else:
+                with obs_tracing.stage("device_compute"):
+                    time.sleep(0.001)
+            return [f"r:{q}" for q in queries]
+
+        mb = MicroBatcher(run_batch, max_batch=4, window_ms=1.0)
+        tracer = Tracer(sample_rate=1.0, slow_quantile=0.0)
+        results = {}
+
+        def submit(role, query, key, deadline):
+            tr = tracer.begin(role, query)
+            try:
+                with obs_tracing.scope((tr,)):
+                    results[role] = mb.submit(
+                        query, key=key, deadline=deadline
+                    )
+                tr.finish(200)
+            except DeadlineExceeded as e:
+                results[role] = e
+                tr.finish(504)
+            tracer.record(tr)
+
+        try:
+            t_blocker = threading.Thread(
+                target=submit, args=("blocker", "other", None, None)
+            )
+            t_blocker.start()
+            assert started.wait(5.0)  # worker now pinned in flight
+            t_leader = threading.Thread(
+                target=submit,
+                args=("leader", "same-query", "k1", Deadline.after_ms(150)),
+            )
+            t_leader.start()
+            # leader must be the registered (queued) coalescing leader
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with mb._key_lock:
+                    if mb._inflight_keys.get("k1") is not None:
+                        break
+                time.sleep(0.005)
+            t_follower = threading.Thread(
+                target=submit,
+                args=("follower", "same-query", "k1", Deadline.after_ms(5000)),
+            )
+            t_follower.start()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with mb._key_lock:
+                    p = mb._inflight_keys.get("k1")
+                    if p is not None and p.followers:
+                        break
+                time.sleep(0.005)
+            t_leader.join(5.0)  # leader gives up at its 150 ms deadline
+            release.set()  # NOW the worker reaches the expired leader
+            t_blocker.join(5.0)
+            t_follower.join(5.0)
+        finally:
+            release.set()
+            mb.stop()
+
+        assert isinstance(results["leader"], DeadlineExceeded)
+        assert results["follower"] == "r:same-query"
+        # the promoted follower's dispatch carried ONE copy of the query
+        assert calls[1:] == [["same-query"]]
+        by_id = {t["requestId"]: t for t in tracer.recent()}
+        leader, follower = by_id["leader"], by_id["follower"]
+        # device charged exactly once: to the promoted follower, which is
+        # the leader at dispatch time and says so
+        assert "device_compute" in follower["stagesMs"]
+        assert follower["meta"]["coalesce"] == "leader"
+        assert follower["meta"]["promoted"] is True
+        # ...and never to the abandoned leader
+        for stage in ("device_compute", "h2d", "batch_assembly"):
+            assert stage not in leader["stagesMs"], leader
+        assert "promoted" not in leader.get("meta", {})

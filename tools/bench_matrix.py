@@ -33,7 +33,7 @@ OUT = os.path.join(REPO, "BENCH_TPU_MANUAL.json")
 _PIN = {"BENCH_REBALANCE": "1", "BENCH_DTYPE": "f32"}
 _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
          "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0",
-         "BENCH_KERNEL": "0"}
+         "BENCH_KERNEL": "0", "BENCH_FLEET": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -203,6 +203,27 @@ def main() -> int:
         ),
         "gate_pass": kern.get("gate_pass"),
     }
+    # fleet gate (ISSUE 10): with one injected slow replica, hedged p99
+    # must come in at or under HALF the unhedged p99, and a rolling
+    # deploy under load must be invisible to clients (zero non-200s) —
+    # either failing means the router's tail-tolerance story regressed
+    flt = primary.get("fleet") or {}
+    roll = flt.get("roll") or {}
+    hedge_ratio = flt.get("hedged_vs_unhedged_p99")
+    artifact["fleet"] = {
+        "qps_1_replica": flt.get("qps_1_replica"),
+        "qps_3_replicas": flt.get("qps_3_replicas"),
+        "scaling_3_over_1": flt.get("scaling_3_over_1"),
+        "p99_unhedged_slow_ms": flt.get("p99_unhedged_slow_ms"),
+        "p99_hedged_ms": flt.get("p99_hedged_ms"),
+        "hedged_vs_unhedged_p99": hedge_ratio,
+        "roll_client_errors": roll.get("client_errors"),
+        "roll_ok": roll.get("ok"),
+        "gate_pass": (
+            isinstance(hedge_ratio, (int, float)) and hedge_ratio <= 0.5
+            and roll.get("client_errors") == 0
+        ),
+    }
     # static-analysis gate: perf numbers from a repo carrying hot-path or
     # race hazards are not publishable — `pio analyze` must report zero
     # errors for the matrix to count
@@ -239,6 +260,7 @@ def main() -> int:
         "observability": artifact["observability"],
         "serving_utilization": artifact["serving_utilization"],
         "kernel": artifact["kernel"],
+        "fleet": artifact["fleet"],
         "analysis": artifact["analysis"],
     }))
     return 0 if all_tpu else 1
