@@ -1332,6 +1332,253 @@ def _fleet_bench(ctx) -> dict:
     return out
 
 
+def _elastic_bench(ctx) -> dict:
+    """Elastic fleet evidence (ISSUE 11): a flash-crowd scenario (10x
+    step) replayed against an autoscaled two-replica fleet, with a
+    seeded ``crash:fleet:replica`` preemption fired mid-surge and a
+    scale-down drain after the crowd passes.
+
+    The gate is "SLO held while scaling": zero client-visible errors
+    across the whole program (shed 503s are the backpressure contract,
+    not errors), flash-phase p99 within ``BENCH_ELASTIC_SLO_P99_MS``,
+    at least one scale-up AND one scale-down actually executed, and the
+    preemption plan actually fired (a chaos run where the kill never
+    landed proves nothing).
+    """
+    import shutil
+    import socket
+    import tempfile
+    import threading
+
+    import predictionio_tpu
+    from predictionio_tpu.common import faults as _faults
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import Event
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.data.storage.sqlite import close_db
+    from predictionio_tpu.serving.autoscaler import Autoscaler
+    from predictionio_tpu.serving.fleet import (
+        PREEMPT_SITE, FleetSupervisor,
+    )
+    from predictionio_tpu.serving.router import ADMITTED, Router
+    from predictionio_tpu.templates.recommendation import (
+        RecommendationEngine,
+    )
+    from predictionio_tpu.tools.scenarios import (
+        parse_scenario, run_scenario,
+    )
+
+    rate = float(os.environ.get("BENCH_ELASTIC_RATE", 25.0))
+    slo_p99_ms = float(os.environ.get("BENCH_ELASTIC_SLO_P99_MS", 1500.0))
+    slow_ms = float(os.environ.get("BENCH_ELASTIC_SLOW_MS", 40.0))
+    tmp = tempfile.mkdtemp(prefix="pio-elastic-bench-")
+    src = "ELASTB"
+    storage_env = {
+        f"PIO_STORAGE_SOURCES_{src}_TYPE": "sqlite",
+        f"PIO_STORAGE_SOURCES_{src}_PATH": os.path.join(
+            tmp, "events.sqlite"
+        ),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+    }
+    old_basedir = os.environ.get("PIO_FS_BASEDIR")
+    os.environ["PIO_FS_BASEDIR"] = os.path.join(tmp, "fs")
+    routers: list = []
+    fleets: list = []
+    scalers: list = []
+    timers: list = []
+    out: dict = {}
+    try:
+        storage = Storage(env=storage_env)
+        store_mod.set_storage(storage)
+        app_id = storage.get_meta_data_apps().insert(App(0, "elasticbench"))
+        le = storage.get_l_events()
+        le.init(app_id)
+        rng = np.random.default_rng(29)
+        events = []
+        for u in range(20):
+            for i in rng.choice(16, size=6, replace=False):
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties={"rating": float(rng.integers(1, 6))},
+                ))
+        le.batch_insert(events, app_id)
+        engine = RecommendationEngine.apply()
+        ep = engine.params_from_variant({
+            "datasource": {"params": {"appName": "elasticbench"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 3}}
+            ],
+        })
+        run_train(engine, ep, "e", storage=storage, ctx=ctx)
+
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(predictionio_tpu.__file__))
+        )
+        child_env = dict(os.environ)
+        child_env.pop("PIO_FAULT_SPEC", None)
+        child_env.update(storage_env)
+        child_env["JAX_PLATFORMS"] = "cpu"
+        child_env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root] + ([child_env["PYTHONPATH"]]
+                           if child_env.get("PYTHONPATH") else [])
+        )
+        # a touch of injected latency so in-flight pressure accumulates
+        # at flash rates (a rank-4 CPU model otherwise answers too fast
+        # for inflight utilization to register)
+        child_env["PIO_FAULT_SPEC"] = (
+            f"site=server:queryserver:/queries.json,kind=latency,"
+            f"latency_ms={slow_ms:g},p=1"
+        )
+
+        def spawn(port):
+            cenv = dict(child_env)
+            cenv["FLEET_CHILD_PORT"] = str(port)
+            return subprocess.Popen(
+                [sys.executable, "-c", _FLEET_CHILD], env=cenv,
+            )
+
+        socks = [socket.socket() for _ in range(2)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+
+        r = Router(
+            [f"http://127.0.0.1:{p}" for p in ports],
+            hedge_enabled=False, telemetry=False,
+        )
+        r.health_interval_ms = 100.0
+        r.outlier_ratio = 1e9
+        # 24 open-loop workers against a 24-per-replica cap: one healthy
+        # replica can absorb the whole crowd at the cap boundary, so a
+        # mid-surge preemption retries cleanly instead of 502ing
+        r.replica_max_inflight = 24
+        routers.append(r)
+        rport = r.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{rport}"
+
+        fleet = FleetSupervisor(spawn, ports, router=r)
+        fleets.append(fleet)
+        r.attach_fleet(fleet)
+        fleet.start()
+
+        t_end = time.time() + 180.0
+        while time.time() < t_end:
+            reps = r.stats()["replicas"]
+            if reps and all(x["state"] == ADMITTED
+                            and x["generation"] is not None for x in reps):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("elastic bench replicas never became ready")
+
+        scaler = Autoscaler(r, fleet)
+        scaler.interval_ms = 300.0
+        scaler.min_replicas = 2
+        scaler.max_replicas = 3
+        scaler.up_threshold = 0.2
+        scaler.down_threshold = 0.1
+        scaler.up_cooldown_s = 1.0
+        scaler.down_cooldown_s = 2.0
+        scaler.down_after = 3
+        scaler.busy_enabled = False  # telemetry=False children: no /metrics
+        scalers.append(scaler)
+        r.attach_autoscaler(scaler)
+        scaler.start()
+
+        program = parse_scenario(
+            f"steady:name=calm,rate={rate:g},duration=6;"
+            f"flash:name=flash,base={rate:g},peak={rate * 10:g},"
+            f"at=2,hold=8,duration=12;"
+            f"steady:name=cooldown,rate={rate:g},duration=8"
+        )
+        # the preemption: a seeded kill -9 of one replica, installed on
+        # a timer so it lands mid-flash while the scaler is growing the
+        # fleet (the supervisor's monitor consults the site every 0.25s)
+        plan = _faults.FaultPlan(
+            [_faults.FaultRule(site=PREEMPT_SITE, kind="crash", times=1)],
+            seed=7,
+        )
+        preempt_timer = threading.Timer(10.0, _faults.install, args=(plan,))
+        preempt_timer.daemon = True
+        timers.append(preempt_timer)
+        preempt_timer.start()
+
+        users = [f"u{i}" for i in range(20)]
+        res = run_scenario(
+            base, {"user": "u1", "num": 3}, program,
+            samples={"user": users}, concurrency=24,
+            slo_p99_ms=slo_p99_ms,
+        )
+
+        # the crowd has passed: give the scaler a moment to drain the
+        # surge replica back out (down_after low ticks + cooldown)
+        t_end = time.time() + 30.0
+        while time.time() < t_end:
+            if scaler.stats()["scaleDowns"] >= 1:
+                break
+            time.sleep(0.25)
+
+        stats = scaler.stats()
+        fired = sum(x["fired"] for x in plan.stats()["rules"])
+        flash = next(
+            (p for p in res["phases"] if p["name"] == "flash"),
+            res["phases"][1],
+        )
+        out["phases"] = [
+            {
+                "name": p["name"],
+                "offered": p["offered"],
+                "ok": p["ok"],
+                "errors": p["errors"],
+                "shed": p["shed"],
+                "p50_ms": p["p50Ms"],
+                "p99_ms": p["p99Ms"],
+            }
+            for p in res["phases"]
+        ]
+        out["client_errors"] = res["errors"]
+        out["shed"] = res["shed"]
+        out["p99_while_scaling_ms"] = flash["p99Ms"]
+        out["slo_p99_ms"] = slo_p99_ms
+        out["worst_lag_s"] = res["worstLagS"]
+        out["scale_ups"] = stats["scaleUps"]
+        out["scale_downs"] = stats["scaleDowns"]
+        out["preemptions"] = fired
+        out["fleet_transitions"] = fleet.status()["transitions"]
+        out["gate_pass"] = bool(
+            res["errors"] == 0
+            and (flash["p99Ms"] or 0.0) <= slo_p99_ms
+            and stats["scaleUps"] >= 1
+            and stats["scaleDowns"] >= 1
+            and fired >= 1
+        )
+    finally:
+        for t in timers:
+            t.cancel()
+        _faults.clear()
+        for sc in scalers:
+            sc.stop()
+        for r in routers:
+            r.stop()
+        for f in fleets:
+            f.stop()
+        store_mod.set_storage(None)
+        close_db(os.path.join(tmp, "events.sqlite"))
+        if old_basedir is None:
+            os.environ.pop("PIO_FS_BASEDIR", None)
+        else:
+            os.environ["PIO_FS_BASEDIR"] = old_basedir
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main() -> None:
     # BENCH_PLATFORM=cpu skips the (slow) tunnel probe for local iteration
     forced_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
@@ -1527,6 +1774,14 @@ def main() -> None:
             print(f"WARNING: fleet bench failed: {e}", file=sys.stderr)
             fleet = {"error": str(e)}
         print(f"INFO: fleet: {fleet}", file=sys.stderr)
+    elastic = None
+    if os.environ.get("BENCH_ELASTIC", "1") != "0":
+        try:
+            elastic = _elastic_bench(ctx)
+        except Exception as e:  # the elastic bench must never kill the artifact
+            print(f"WARNING: elastic bench failed: {e}", file=sys.stderr)
+            elastic = {"error": str(e)}
+        print(f"INFO: elastic: {elastic}", file=sys.stderr)
     record = {
         "metric": "als_train_events_per_sec_per_chip",
         "value": round(value, 1),
@@ -1567,6 +1822,8 @@ def main() -> None:
         record["kernel"] = kernel
     if fleet is not None:
         record["fleet"] = fleet
+    if elastic is not None:
+        record["elastic"] = elastic
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

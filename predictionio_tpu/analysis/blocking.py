@@ -11,9 +11,10 @@ batcher already uses.
 Scope: every function in the dispatch modules except constructors and
 teardown (``__init__``/``_compile``/``stats``/``stop``/``close``), plus
 worker-loop functions (``_loop``/``_run``/``_flush``/``_drain``/
-``_health_loop``/``_monitor_loop`` — the last two are the fleet
-router's health prober and the fleet supervisor's child watcher) in the
-rest of ``serving/`` and ``data/api/``.  ``Condition.wait``/
+``_health_loop``/``_monitor_loop``/``_control_loop`` — the last three
+are the fleet router's health prober, the fleet supervisor's child
+watcher, and the autoscaler's decision pacer) in the rest of
+``serving/`` and ``data/api/``.  ``Condition.wait``/
 ``Event.wait`` are the sanctioned blocking primitives and are not
 flagged.
 """
@@ -39,11 +40,12 @@ _HOT_MODULES = ("batching.py", "fastpath.py")
 _EXEMPT_FUNCS = {"__init__", "_compile", "stats", "stop", "close",
                  "__repr__"}
 # worker-loop functions checked across the wider threaded scope
-# (_health_loop/_monitor_loop: the router's probe pacer and the fleet
-# supervisor's child watcher — both must pace on Event.wait and delegate
-# real I/O to non-loop helpers)
+# (_health_loop/_monitor_loop/_control_loop: the router's probe pacer,
+# the fleet supervisor's child watcher, and the autoscaler's decision
+# pacer — all must pace on Event.wait and delegate real I/O to
+# non-loop helpers)
 _HOT_LOOP_NAMES = {"_loop", "_run", "_flush", "_drain",
-                   "_health_loop", "_monitor_loop"}
+                   "_health_loop", "_monitor_loop", "_control_loop"}
 
 # callee name → why it blocks
 _BLOCKING_ATTRS = {

@@ -21,6 +21,10 @@ SEEDED schedule, at named fault SITES compiled into the service planes:
   A matching ``crash`` rule hard-kills the process with ``os._exit(137)``
   — no atexit hooks, no flushes, the same observable death as ``kill -9``
   — so recovery tests exercise real torn state rather than mocks.
+* ``crash:fleet:replica`` — consulted by the fleet supervisor's monitor
+  loop through :func:`kill_point`: a matching ``crash`` rule SIGKILLs one
+  seeded-random *child* replica per firing, the preemption primitive the
+  elastic-fleet chaos suite schedules mid-scale-up.
 
 Nothing fires unless a plan is installed — the shim is one ``is None``
 check on the hot path.  Installation is programmatic (:func:`install`,
@@ -57,6 +61,7 @@ class FaultAction:
     latency_s: float = 0.0
     status: int = 503
     rule: int = 0  # index of the rule that fired (observability)
+    ordinal: int = 0  # the rule's n-th matching call (seeds victim picks)
 
 
 @dataclass
@@ -122,6 +127,7 @@ class FaultPlan:
                 latency_s=rule.latency_ms / 1e3,
                 status=rule.status,
                 rule=idx,
+                ordinal=n,
             )
         return None
 
@@ -208,6 +214,37 @@ def crash_point(site: str) -> None:
         import os
 
         os._exit(CRASH_EXIT_CODE)
+
+
+def kill_point(site: str, pids: list[int]) -> Optional[int]:
+    """A SUPERVISOR-side preemption site: where :func:`crash_point` kills
+    the calling process, this SIGKILLs one of the given *child* pids on
+    the plan's seeded schedule (the fleet monitor consults it as
+    ``crash:fleet:replica``, so chaos plans can preempt random replicas
+    while the fleet is scaling).  The victim is deterministic for a given
+    schedule: ``(seed, rule, ordinal)`` picks an index into the sorted pid
+    list.  Returns the killed pid, or None when nothing fired, no pids
+    were offered, or the victim died before the signal landed.
+    """
+    plan = active()
+    if plan is None or not pids:
+        return None
+    act = plan.on_call(site)
+    if act is None or act.kind != "crash":
+        return None
+    import os
+    import signal
+
+    ordered = sorted(pids)
+    pick = random.Random(
+        f"{plan.seed}:{act.rule}:{act.ordinal}:victim"
+    ).randrange(len(ordered))
+    victim = ordered[pick]
+    try:
+        os.kill(victim, signal.SIGKILL)
+    except OSError:
+        return None
+    return victim
 
 
 def parse_spec(spec: str) -> list[FaultRule]:

@@ -516,6 +516,118 @@ def bridge_resilience(
     registry.register_collector(collect)
 
 
+# -- serving: fleet supervisor + autoscaler ----------------------------------
+
+def bridge_fleet(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """FleetSupervisor ``stats()`` → pio_fleet_* process-lifecycle
+    series, so crash-restarts and scale events are visible on the
+    router's /metrics instead of only in its logs."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        trans = s.get("transitions") or {}
+        fams = [
+            _fam(
+                "pio_fleet_replicas", "gauge",
+                "Replica processes currently under supervision.",
+                [("", (), _num(s.get("replicas")))],
+            ),
+            _fam(
+                "pio_fleet_replicas_alive", "gauge",
+                "Supervised replica processes currently running.",
+                [("", (), _num(s.get("alive")))],
+            ),
+            _fam(
+                "pio_fleet_restarts_total", "counter",
+                "Crash-restarts performed by the supervisor.",
+                [("", (), _num(s.get("restarts")))],
+            ),
+            _fam(
+                "pio_fleet_transitions_total", "counter",
+                "Replica lifecycle transitions: up (process spawned) and "
+                "down (crash observed or replica scaled away).",
+                [
+                    ("", (("direction", "up"),), _num(trans.get("up"))),
+                    ("", (("direction", "down"),), _num(trans.get("down"))),
+                ],
+            ),
+        ]
+        backoff = s.get("backoffMs")
+        if isinstance(backoff, dict) and backoff:
+            fams.append(
+                _fam(
+                    "pio_fleet_replica_backoff_ms", "gauge",
+                    "Current crash-restart backoff per replica slot "
+                    "(0 after a healthy stretch).",
+                    [
+                        ("", (("replica", str(url)),), _num(ms))
+                        for url, ms in sorted(backoff.items())
+                    ],
+                )
+            )
+        return fams
+
+    registry.register_collector(collect)
+
+
+def bridge_autoscaler(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """Autoscaler ``stats()`` → pio_autoscaler_* decision series (the
+    composite pressure, its per-signal inputs, and scale events)."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        sigs = s.get("signals") or {}
+        decision = {"down": -1.0, "hold": 0.0, "up": 1.0}.get(
+            s.get("lastDecision"), 0.0
+        )
+        return [
+            _fam(
+                "pio_autoscaler_replicas_target", "gauge",
+                "Replica count the autoscaler is currently holding the "
+                "fleet at.",
+                [("", (), _num(s.get("replicas")))],
+            ),
+            _fam(
+                "pio_autoscaler_pressure", "gauge",
+                "Composite load pressure (max of the normalized signals) "
+                "driving scale decisions.",
+                [("", (), _num(s.get("pressure")))],
+            ),
+            _fam(
+                "pio_autoscaler_signal", "gauge",
+                "Normalized [0,1] per-signal pressure feeding the "
+                "composite (inflight, shed, hedge, busy).",
+                [
+                    ("", (("signal", str(k)),), _num(v))
+                    for k, v in sorted(sigs.items())
+                ],
+            ),
+            _fam(
+                "pio_autoscaler_scale_events_total", "counter",
+                "Scale decisions executed, by direction.",
+                [
+                    ("", (("direction", "up"),), _num(s.get("scaleUps"))),
+                    ("", (("direction", "down"),), _num(s.get("scaleDowns"))),
+                ],
+            ),
+            _fam(
+                "pio_autoscaler_last_decision", "gauge",
+                "Most recent control decision: -1 down, 0 hold, 1 up.",
+                [("", (), decision)],
+            ),
+        ]
+
+    registry.register_collector(collect)
+
+
 # -- data plane: event-server Stats + ingest buffer --------------------------
 
 def bridge_event_stats(registry: MetricsRegistry, stats) -> None:

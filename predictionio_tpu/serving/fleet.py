@@ -20,6 +20,18 @@ exponential backoff (reset after a healthy period), and orchestrates
 deploy`` processes; ``pio fleet roll`` triggers ``roll()`` through the
 router's ``POST /fleet/roll``.
 
+The fleet is **elastic** (ISSUE 11): :meth:`add_replica` spawns one
+more replica on a freshly allocated port and registers it EJECTED at
+the router (admission rides the health gate + slow start), and
+:meth:`remove_replica` retires one with the same drain-before-kill
+sequence a roll uses.  ``_ops_lock`` serializes rolls against
+scale-downs so the same process is never stopped twice and a drained
+replica is never orphaned — the roll-vs-scale-down race has dedicated
+test coverage.  The monitor loop doubles as the preemption chaos site:
+each tick consults ``crash:fleet:replica`` through
+:func:`~predictionio_tpu.common.faults.kill_point`, so a seeded fault
+plan can SIGKILL a random replica *while* the fleet is scaling.
+
 The supervisor is process-management only: it never sits on the query
 path.  Spawning is delegated to a ``spawn_fn(port) -> subprocess.Popen``
 so tests can run replicas from a ``python -c`` script and the CLI can
@@ -31,6 +43,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import socket
 import subprocess
 import threading
 import time
@@ -38,7 +51,13 @@ import urllib.error
 import urllib.request
 from typing import Callable, Optional
 
+from predictionio_tpu.common import faults as _faults
+
 logger = logging.getLogger(__name__)
+
+#: Fault site the monitor loop exposes for preemption chaos: a matching
+#: ``crash`` rule SIGKILLs one live replica per firing (seeded victim).
+PREEMPT_SITE = "crash:fleet:replica"
 
 
 def _env_num(name: str, default, cast):
@@ -61,6 +80,7 @@ class ReplicaProc:
         self.next_restart_at = 0.0
         self.started_at = 0.0
         self.expected_down = False  # a roll is restarting it on purpose
+        self.removing = False  # a scale-down is retiring it for good
 
 
 class FleetSupervisor:
@@ -72,16 +92,23 @@ class FleetSupervisor:
         ports: list[int],
         host: str = "127.0.0.1",
         router=None,
+        port_allocator: Optional[Callable[[], int]] = None,
     ):
         self.spawn_fn = spawn_fn
         self.host = host
         self.router = router
+        self.port_allocator = port_allocator
         self._procs = [
             ReplicaProc(p, f"http://{host}:{p}") for p in ports
         ]
         self._lock = threading.Lock()
+        # serializes whole-replica operations (roll step, scale-down) so
+        # concurrent ops can never double-stop or orphan one process;
+        # always acquired BEFORE _lock, never the other way around
+        self._ops_lock = threading.Lock()
         self._stop_evt = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
+        self._transitions = {"up": 0, "down": 0}
         self.restart_backoff_s = _env_num(
             "PIO_FLEET_RESTART_BACKOFF_S", 0.5, float
         )
@@ -108,6 +135,7 @@ class FleetSupervisor:
         rp.proc = self.spawn_fn(rp.port)
         rp.started_at = time.monotonic()
         rp.expected_down = False
+        self._transitions["up"] += 1
         logger.info(
             "fleet: replica on port %d spawned (pid %s)",
             rp.port, rp.proc.pid,
@@ -120,6 +148,7 @@ class FleetSupervisor:
     def _check_children(self) -> None:
         """Restart crashed replicas with exponential backoff; a replica
         that stayed up past its backoff window resets to the base."""
+        self._preempt_point()
         now = time.monotonic()
         with self._lock:
             for rp in self._procs:
@@ -143,6 +172,7 @@ class FleetSupervisor:
                         self.restart_backoff_max_s,
                     )
                     rp.next_restart_at = now + delay
+                    self._transitions["down"] += 1
                     logger.warning(
                         "fleet: replica on port %d exited rc=%s; restart "
                         "in %.1fs", rp.port, rp.proc.returncode, delay,
@@ -152,17 +182,121 @@ class FleetSupervisor:
                     rp.next_restart_at = 0.0
                     self._spawn_locked(rp)
 
+    def _preempt_point(self) -> None:
+        """Preemption chaos site: let a seeded ``crash:fleet:replica``
+        fault rule SIGKILL one live replica.  The monitor tick is the
+        ordinal clock, so ``after=N`` schedules a kill ~N*0.25s in."""
+        if _faults.active() is None:
+            return
+        with self._lock:
+            pids = [
+                rp.proc.pid
+                for rp in self._procs
+                if rp.proc is not None
+                and not rp.expected_down
+                and rp.proc.poll() is None
+            ]
+        pid = _faults.kill_point(PREEMPT_SITE, pids)
+        if pid is not None:
+            logger.warning(
+                "fault shim preempted replica pid %d (kill -9)", pid
+            )
+
+    # -- elastic scaling -----------------------------------------------------
+    def _alloc_port(self) -> int:
+        if self.port_allocator is not None:
+            return self.port_allocator()
+        s = socket.socket()
+        try:
+            s.bind((self.host, 0))
+            return s.getsockname()[1]
+        finally:
+            s.close()
+
+    def add_replica(self) -> Optional[dict]:
+        """Scale up by one: spawn a replica on a fresh port and register
+        it at the router (EJECTED — the health gate + slow start admit
+        it).  Returns the new slot, or None if the spawn failed."""
+        with self._ops_lock:
+            port = self._alloc_port()
+            rp = ReplicaProc(port, f"http://{self.host}:{port}")
+            try:
+                with self._lock:
+                    self._spawn_locked(rp)
+                    self._procs.append(rp)
+            except Exception:
+                logger.exception(
+                    "fleet: scale-up spawn on port %d failed", port
+                )
+                return None
+            if self.router is not None:
+                self.router.add_replica(rp.url)
+            return {"port": rp.port, "url": rp.url}
+
+    def remove_replica(self, url: Optional[str] = None) -> Optional[dict]:
+        """Scale down by one: drain-before-kill (router DRAINING →
+        ``POST /stop`` → reap), then forget the slot and deregister the
+        URL at the router.  Picks the newest removable replica unless
+        ``url`` names one.  Returns the retired slot, or None when the
+        fleet has nothing removable (e.g. everything is mid-roll)."""
+        with self._ops_lock:
+            with self._lock:
+                cands = [
+                    rp for rp in self._procs
+                    if not rp.expected_down and not rp.removing
+                ]
+                if url is not None:
+                    cands = [rp for rp in cands if rp.url == url]
+                if not cands:
+                    return None
+                rp = cands[-1]  # newest first: keep long-warm replicas
+                rp.removing = True
+                rp.expected_down = True  # monitor must not respawn it
+                proc = rp.proc
+            try:
+                if self.router is not None:
+                    self.router.set_replica_draining(rp.url, True)
+                if proc is not None and proc.poll() is None:
+                    self._post_stop(rp.url)
+                    try:
+                        proc.wait(timeout=self.stop_timeout_s)
+                    except subprocess.TimeoutExpired:
+                        logger.warning(
+                            "fleet: replica on port %d ignored scale-down "
+                            "drain; killing", rp.port,
+                        )
+                        proc.kill()
+                        try:
+                            proc.wait(timeout=5)
+                        except subprocess.TimeoutExpired:
+                            pass
+            finally:
+                with self._lock:
+                    self._procs = [p for p in self._procs if p is not rp]
+                    self._transitions["down"] += 1
+                if self.router is not None:
+                    self.router.remove_replica(rp.url)
+            logger.info("fleet: replica on port %d scaled down", rp.port)
+            return {"port": rp.port, "url": rp.url}
+
     # -- rolling deploy ------------------------------------------------------
     def roll(self) -> dict:
         """Drain → restart → verify each replica in sequence.  Returns a
         per-replica report; raises nothing (a failed replica is reported
         and the roll continues — partial fleets beat dead rolls)."""
+        with self._lock:
+            procs = [rp for rp in self._procs if not rp.removing]
         report = []
-        for rp in self._procs:
+        for rp in procs:
             entry = {"port": rp.port, "url": rp.url}
             try:
-                self._roll_one(rp)
-                entry["ok"] = True
+                if self._roll_one(rp):
+                    entry["ok"] = True
+                else:
+                    # a concurrent scale-down retired it first — nothing
+                    # to roll, and definitely nothing to stop twice
+                    entry["ok"] = True
+                    entry["skipped"] = "scaled down"
             except Exception as e:
                 entry["ok"] = False
                 entry["error"] = f"{type(e).__name__}: {e}"
@@ -172,8 +306,18 @@ class FleetSupervisor:
             report.append(entry)
         return {"replicas": report, "ok": all(e["ok"] for e in report)}
 
-    def _roll_one(self, rp: ReplicaProc) -> None:
+    def _roll_one(self, rp: ReplicaProc) -> bool:
+        """Roll one replica; returns False when a concurrent scale-down
+        already retired it (the ops lock makes the check authoritative:
+        whoever holds it owns the replica's process end to end)."""
+        with self._ops_lock:
+            return self._roll_one_owned(rp)
+
+    def _roll_one_owned(self, rp: ReplicaProc) -> bool:
         deadline = time.monotonic() + self.roll_timeout_s
+        with self._lock:
+            if rp.removing or rp not in self._procs:
+                return False
         if self.router is not None:
             self.router.set_replica_draining(rp.url, True)
         with self._lock:
@@ -201,6 +345,7 @@ class FleetSupervisor:
                 self.router.set_replica_draining(rp.url, False)
         if self.router is not None:
             self._wait_admitted(rp.url, deadline)
+        return True
 
     def _post_stop(self, url: str) -> None:
         try:
@@ -257,11 +402,26 @@ class FleetSupervisor:
                             rp.proc is not None and rp.proc.poll() is None
                         ),
                         "restarts": rp.restarts,
+                        "backoffMs": round(rp.backoff_s * 1e3, 1),
                         "rolling": rp.expected_down,
+                        "removing": rp.removing,
                     }
                     for rp in self._procs
-                ]
+                ],
+                "transitions": dict(self._transitions),
             }
+
+    def stats(self) -> dict:
+        """Flat snapshot for the ``pio_fleet_*`` metrics bridge."""
+        st = self.status()
+        reps = st["replicas"]
+        return {
+            "replicas": len(reps),
+            "alive": sum(1 for r in reps if r["alive"]),
+            "restarts": sum(r["restarts"] for r in reps),
+            "backoffMs": {r["url"]: r["backoffMs"] for r in reps},
+            "transitions": st["transitions"],
+        }
 
     def stop(self) -> None:
         """Stop supervising and tear the children down (drain first,

@@ -809,6 +809,26 @@ class QueryServer:
                 self._rl_log.exception("feedback", "feedback POST failed")
 
     # -- routes ----------------------------------------------------------------
+    def retry_after_s(self) -> float:
+        """Backpressure-aware ``Retry-After``: ``shed_retry_after_s`` is
+        the BASE.  While draining the hint is the drain budget (the
+        earliest a replacement process could answer here); under load
+        it scales with queue depth — inflight plus batcher backlog over
+        the admission cap — so clients back off longer the deeper the
+        overload.  Reads ``_inflight`` without its lock: a torn read
+        costs at most one slightly-off hint, and one shed site calls
+        this while already holding the lock."""
+        if self._draining:
+            return max(self.shed_retry_after_s, self.drain_timeout_ms / 1e3)
+        depth = float(self._inflight)
+        if self._batcher is not None:
+            try:
+                depth += float(self._batcher.stats().get("depth") or 0)
+            except Exception:
+                pass
+        load = depth / float(max(1, self.max_inflight))
+        return round(min(self.shed_retry_after_s * max(1.0, load), 30.0), 2)
+
     def _register_routes(self):
         svc = self.service
 
@@ -890,7 +910,7 @@ class QueryServer:
             }
             # every not-ready answer carries Retry-After, as the shed paths
             # do — docs/operations.md promises the header on all 503s
-            retry = {"Retry-After": f"{self.shed_retry_after_s:g}"}
+            retry = {"Retry-After": f"{self.retry_after_s():g}"}
             if self._draining:
                 body["status"] = "draining"
                 return Response(status=503, body=body, headers=retry)
@@ -915,7 +935,7 @@ class QueryServer:
                     status=503,
                     body={"message": "server draining; retry against "
                           "another instance"},
-                    headers={"Retry-After": f"{self.shed_retry_after_s:g}"},
+                    headers={"Retry-After": f"{self.retry_after_s():g}"},
                 )
             # admission control: beyond max_inflight, queueing only adds
             # latency to requests that will miss their deadlines anyway —
@@ -926,7 +946,7 @@ class QueryServer:
                     return Response(
                         status=503,
                         body={"message": "server overloaded; request shed"},
-                        headers={"Retry-After": f"{self.shed_retry_after_s:g}"},
+                        headers={"Retry-After": f"{self.retry_after_s():g}"},
                     )
                 self._inflight += 1
             try:

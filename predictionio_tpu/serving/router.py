@@ -140,6 +140,7 @@ class Router:
         self._stop_evt = threading.Event()
         self._draining = False
         self._fleet = None
+        self._autoscaler = None
         self._rolling = False
         self.default_deadline_ms = default_deadline_ms
         # knobs (each read in exactly one place; documented in
@@ -236,6 +237,70 @@ class Router:
     def available_count(self) -> int:
         with self._lock:
             return sum(1 for r in self._replicas if r.state == ADMITTED)
+
+    # -- elastic replica set -------------------------------------------------
+    def add_replica(self, url: str) -> bool:
+        """Register a scale-up replica.  It starts EJECTED: the health
+        gate must see /readyz + warm before any traffic lands, and then
+        slow start ramps its share 10% → 100% — a cold process never
+        absorbs a full split.  Returns False on a duplicate URL."""
+        url = url.rstrip("/")
+        now = time.monotonic()
+        with self._lock:
+            if any(r.url == url for r in self._replicas):
+                return False
+            rep = ReplicaState(url, now)
+            rep.state = EJECTED
+            self._replicas = self._replicas + [rep]
+        logger.info("router: replica %s registered (awaiting health gate)",
+                    url)
+        return True
+
+    def remove_replica(self, url: str) -> bool:
+        """Forget a scaled-down replica entirely (probing included)."""
+        url = url.rstrip("/")
+        with self._lock:
+            keep = [r for r in self._replicas if r.url != url]
+            removed = len(keep) != len(self._replicas)
+            self._replicas = keep
+        if removed:
+            logger.info("router: replica %s deregistered", url)
+        return removed
+
+    def signals(self) -> dict:
+        """The autoscaler's input: one consistent snapshot of the load
+        signals the router already maintains for its own decisions."""
+        with self._lock:
+            admitted = [r for r in self._replicas if r.state == ADMITTED]
+            return {
+                "replicas": len(self._replicas),
+                "admitted": len(admitted),
+                "inflight": sum(r.inflight for r in self._replicas),
+                "replicaMaxInflight": self.replica_max_inflight,
+                "admittedUrls": [r.url for r in admitted],
+                "counters": self.counters.snapshot(),
+                "rolling": self._rolling,
+            }
+
+    def _retry_after_s(self) -> float:
+        """Backpressure-aware ``Retry-After``: PIO_ROUTER_RETRY_AFTER_S is
+        the BASE, scaled by live fleet state so clients back off longer
+        the deeper the overload.  With no admitted replica the hint is
+        the health gate's readmission horizon (a fresh or restarted
+        process cannot answer sooner than readmit_after probes)."""
+        base = self.shed_retry_after_s
+        with self._lock:
+            admitted = [r for r in self._replicas if r.state == ADMITTED]
+            inflight = sum(r.inflight for r in self._replicas)
+        if not admitted:
+            probe_s = (self.health_interval_ms / 1e3) * max(
+                1, self.readmit_after
+            )
+            return round(min(max(base, probe_s), 30.0), 2)
+        load = inflight / float(
+            max(1, self.replica_max_inflight) * len(admitted)
+        )
+        return round(min(base * max(1.0, load), 30.0), 2)
 
     # -- latency window / hedge delay ----------------------------------------
     def _record_latency(self, rep: ReplicaState, ms: float) -> None:
@@ -420,7 +485,7 @@ class Router:
             return Response(
                 status=503,
                 body={"message": "router draining"},
-                headers={"Retry-After": f"{self.shed_retry_after_s:g}"},
+                headers={"Retry-After": f"{self._retry_after_s():g}"},
             )
         deadline = parse_deadline_header(req.headers.get(DEADLINE_HEADER))
         if deadline is None and self.default_deadline_ms is not None:
@@ -443,7 +508,7 @@ class Router:
             return Response(
                 status=503,
                 body={"message": "no replica available"},
-                headers={"Retry-After": f"{self.shed_retry_after_s:g}"},
+                headers={"Retry-After": f"{self._retry_after_s():g}"},
             )
         self._spawn_attempt(slot, rep, req.body, deadline, False, trace_id)
         if self.hedge_enabled:
@@ -486,7 +551,7 @@ class Router:
             return Response(
                 status=502,
                 body={"message": "all replicas failed"},
-                headers={"Retry-After": f"{self.shed_retry_after_s:g}"},
+                headers={"Retry-After": f"{self._retry_after_s():g}"},
             )
         status, rbody, rheaders = result
         if status < 400:
@@ -503,7 +568,7 @@ class Router:
         retry_after = (rheaders or {}).get("Retry-After")
         if status == 503:
             out.headers["Retry-After"] = (
-                retry_after or f"{self.shed_retry_after_s:g}"
+                retry_after or f"{self._retry_after_s():g}"
             )
         return out
 
@@ -611,6 +676,16 @@ class Router:
         rolls can drain replicas at the ROUTER before the replica sheds."""
         with self._lock:
             self._fleet = fleet
+        if self.telemetry is not None and hasattr(fleet, "stats"):
+            _bridges.bridge_fleet(self.telemetry.registry, fleet.stats)
+
+    def attach_autoscaler(self, scaler) -> None:
+        """Wire an Autoscaler: its decisions surface on `/fleet` and as
+        ``pio_autoscaler_*`` families on this router's /metrics."""
+        with self._lock:
+            self._autoscaler = scaler
+        if self.telemetry is not None:
+            _bridges.bridge_autoscaler(self.telemetry.registry, scaler.stats)
 
     def set_replica_draining(self, url: str, draining: bool) -> None:
         """Roll orchestration: stop routing to a replica BEFORE its
@@ -790,7 +865,7 @@ class Router:
                 return json_response(200, body)
             return Response(
                 status=503, body=body,
-                headers={"Retry-After": f"{self.shed_retry_after_s:g}"},
+                headers={"Retry-After": f"{self._retry_after_s():g}"},
             )
 
         @svc.route("POST", r"/queries\.json")
@@ -801,14 +876,16 @@ class Router:
         def fleet_status(req: Request):
             with self._lock:
                 fleet = self._fleet
+                scaler = self._autoscaler
                 rolling = self._rolling
             if fleet is None:
                 return json_response(
                     404, {"message": "no fleet supervisor attached"}
                 )
-            return json_response(
-                200, {"rolling": rolling, "fleet": fleet.status()}
-            )
+            body = {"rolling": rolling, "fleet": fleet.status()}
+            if scaler is not None:
+                body["autoscaler"] = scaler.stats()
+            return json_response(200, body)
 
         @svc.route("POST", r"/fleet/roll")
         def fleet_roll(req: Request):

@@ -381,27 +381,48 @@ def _child_deploy_argv(args, port: int) -> list[str]:
 def _deploy_fleet(args) -> int:
     """``pio deploy --fleet N``: N replica subprocesses on ports
     port+1..port+N behind a health-checked, hedging router on ``port``,
-    supervised for crash-restart and rolling deploys."""
+    supervised for crash-restart and rolling deploys.  With
+    ``--autoscale`` (or ``PIO_AUTOSCALE=1``) an autoscaler control loop
+    grows/shrinks the replica set from the router's own load signals;
+    scale-up replicas take the next sequential ports past the initial
+    range."""
+    import itertools
     import subprocess
 
+    from predictionio_tpu.serving.autoscaler import Autoscaler
     from predictionio_tpu.serving.fleet import FleetSupervisor
     from predictionio_tpu.serving.router import Router
 
     ports = [args.port + 1 + i for i in range(args.fleet)]
+    next_ports = itertools.count(args.port + 1 + args.fleet)
 
     def spawn(port: int) -> subprocess.Popen:
         return subprocess.Popen(_child_deploy_argv(args, port))
 
     router = Router([f"http://127.0.0.1:{p}" for p in ports])
-    fleet = FleetSupervisor(spawn, ports, router=router)
+    fleet = FleetSupervisor(
+        spawn, ports, router=router,
+        port_allocator=lambda: next(next_ports),
+    )
     router.attach_fleet(fleet)
+    autoscale = (
+        getattr(args, "autoscale", False)
+        or os.environ.get("PIO_AUTOSCALE", "0") != "0"
+    )
+    scaler = None
+    if autoscale:
+        scaler = Autoscaler(router, fleet)
+        router.attach_autoscaler(scaler)
     fleet.start()
+    if scaler is not None:
+        scaler.start()
     port = router.start(args.ip, args.port)
     _install_drain_handler(router)
     print(
         f"[INFO] Fleet of {args.fleet} replicas (ports "
         f"{ports[0]}-{ports[-1]}) is deploying behind the router at "
         f"http://{args.ip}:{port}. Roll with `pio fleet roll`."
+        + (" Autoscaler is active." if scaler is not None else "")
     )
     try:
         router.service.serve_forever()
@@ -744,6 +765,31 @@ def cmd_loadtest(args) -> int:
             print(f"[ERROR] --sample expects FIELD=v1,v2,..., got {spec!r}")
             return 1
         samples[field] = values
+    if args.scenario:
+        # scenario mode: a time-varying traffic program with per-phase
+        # SLO accounting instead of constant closed-loop load
+        from predictionio_tpu.tools.scenarios import (
+            parse_scenario, run_scenario,
+        )
+        try:
+            program = parse_scenario(args.scenario)
+        except ValueError as e:
+            print(f"[ERROR] bad --scenario: {e}")
+            return 1
+        result = run_scenario(
+            url=url,
+            query=json.loads(args.query),
+            program=program,
+            samples=samples or None,
+            concurrency=args.concurrency,
+            deadline_ms=args.deadline_ms,
+            seed=args.seed,
+            zipf_q=args.zipf_q,
+            slo_p99_ms=args.slo_p99_ms,
+        )
+        print(json.dumps(attach_metrics(result)))
+        ok = result["errors"] == 0 and result.get("sloHeld", True)
+        return 0 if ok else 1
     result = run_loadtest(
         url=url,
         query=json.loads(args.query),
@@ -1043,6 +1089,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve N replica subprocesses (ports PORT+1..PORT+N) behind "
         "a health-checked, hedging router on PORT",
     )
+    sp.add_argument(
+        "--autoscale", action="store_true",
+        help="with --fleet: scale the replica set up/down from the "
+        "router's load signals (PIO_AUTOSCALE_* knobs set the bounds "
+        "and thresholds); equivalent to PIO_AUTOSCALE=1",
+    )
     sp.set_defaults(func=cmd_deploy)
 
     sp = sub.add_parser(
@@ -1191,6 +1243,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="POST /stop to the server this many seconds into the run — "
         "exercises graceful drain under live load; post-stop connection "
         "failures are reported as afterStop, not errors",
+    )
+    sp.add_argument(
+        "--scenario", default=None, metavar="SPEC",
+        help="time-varying traffic program instead of constant load: "
+        "';'-separated phases of kind:key=val,... (steady, ramp, sine, "
+        "flash, zipfdrift, mixshift — see docs/operations.md); reports "
+        "p50/p99/shed/error per phase",
+    )
+    sp.add_argument(
+        "--slo-p99-ms", type=float, default=None,
+        help="--scenario mode: per-phase p99 SLO bound; each phase gets "
+        "a sloHeld verdict and the exit code fails if any phase breaks it",
+    )
+    sp.add_argument(
+        "--seed", type=int, default=0,
+        help="--scenario mode: seed for the pre-drawn workload schedule "
+        "(zipf draws, tenant-mix picks) — same seed, same workload",
     )
     sp.set_defaults(func=cmd_loadtest)
 

@@ -29,9 +29,11 @@ import urllib.request
 
 import pytest
 
+from predictionio_tpu.common import faults
 from predictionio_tpu.common.http import HttpService, Response, json_response
 from predictionio_tpu.common.resilience import DEADLINE_HEADER, RetryBudget
-from predictionio_tpu.serving.fleet import FleetSupervisor
+from predictionio_tpu.serving.autoscaler import Autoscaler
+from predictionio_tpu.serving.fleet import PREEMPT_SITE, FleetSupervisor
 from predictionio_tpu.serving.router import ADMITTED, EJECTED, Router
 
 
@@ -449,6 +451,355 @@ class TestFleetSupervisor:
         assert not st["alive"]
 
 
+# -- elastic replica set at the router (ISSUE 11) -----------------------------
+
+
+class TestElasticRouter:
+    def test_add_replica_admits_through_health_gate(
+        self, stubs, router_factory
+    ):
+        a, b = stubs(2)
+        router, base = router_factory([a.url], fast_health=True)
+        assert router.add_replica(b.url) is True
+        # a duplicate registration is refused, not doubled
+        assert router.add_replica(b.url + "/") is False
+        by_url = {r["url"]: r for r in router.stats()["replicas"]}
+        # scale-up replicas start EJECTED: no traffic before the probe
+        assert by_url[b.url]["state"] == EJECTED
+        wait_until(
+            lambda: {
+                r["url"]: r for r in router.stats()["replicas"]
+            }[b.url]["state"] == ADMITTED,
+            timeout=5.0, msg="scale-up replica never admitted",
+        )
+        # fresh admission rides slow start, weight ramping from 10%
+        assert by_url[b.url]["weight"] <= 1.0
+
+    def test_remove_replica_deregisters(self, stubs, router_factory):
+        a, b = stubs(2)
+        router, base = router_factory([a.url, b.url])
+        assert router.remove_replica(b.url) is True
+        assert [r["url"] for r in router.stats()["replicas"]] == [a.url]
+        assert router.remove_replica(b.url) is False
+        # traffic keeps flowing on the survivor
+        status, body, _ = call("POST", base + "/queries.json", {"q": 1})
+        assert status == 200 and body["who"] == a.url
+
+    def test_signals_snapshot_shape(self, stubs, router_factory):
+        a, b = stubs(2)
+        router, _ = router_factory([a.url, b.url], start=False)
+        sig = router.signals()
+        assert sig["replicas"] == 2 and sig["admitted"] == 2
+        assert sig["inflight"] == 0 and sig["rolling"] is False
+        assert sorted(sig["admittedUrls"]) == sorted([a.url, b.url])
+        assert sig["replicaMaxInflight"] >= 1
+        assert "shed" in sig["counters"]
+
+    def test_retry_after_scales_with_queue_depth(
+        self, stubs, router_factory
+    ):
+        a, = stubs(1)
+        router, _ = router_factory([a.url], start=False)
+        router.shed_retry_after_s = 1.0
+        router.replica_max_inflight = 10
+        # idle fleet: the hint is the base
+        assert router._retry_after_s() == 1.0
+        # 3x oversubscribed: the hint scales with load
+        with router._lock:
+            router._replicas[0].inflight = 30
+        assert router._retry_after_s() == 3.0
+        # no admitted replica: the hint is the readmission horizon
+        router.health_interval_ms = 1000.0
+        router.readmit_after = 4
+        with router._lock:
+            router._replicas[0].state = EJECTED
+        assert router._retry_after_s() == 4.0
+
+
+# -- autoscaler control loop (ISSUE 11) ---------------------------------------
+
+
+class FakeSignalRouter:
+    """Router facade: the autoscaler only ever calls ``signals()``."""
+
+    def __init__(self, admitted=2, max_inflight=10):
+        self.sig = {
+            "replicas": admitted,
+            "admitted": admitted,
+            "inflight": 0,
+            "replicaMaxInflight": max_inflight,
+            "admittedUrls": [],
+            "counters": {},
+            "rolling": False,
+        }
+
+    def signals(self):
+        return dict(self.sig)
+
+
+class FakeFleet:
+    """Supervisor facade: counts scale ops, never spawns a process."""
+
+    def __init__(self, n=2):
+        self.n = n
+
+    def status(self):
+        return {
+            "replicas": [{"url": f"http://r{i}"} for i in range(self.n)]
+        }
+
+    def add_replica(self):
+        self.n += 1
+        return {"port": 0, "url": f"http://r{self.n}"}
+
+    def remove_replica(self, url=None):
+        if self.n == 0:
+            return None
+        self.n -= 1
+        return {"port": 0, "url": f"http://r{self.n}"}
+
+
+def make_scaler(router, fleet, **overrides):
+    sc = Autoscaler(router, fleet)
+    sc.min_replicas = 1
+    sc.max_replicas = 4
+    sc.up_threshold = 0.7
+    sc.down_threshold = 0.25
+    sc.up_cooldown_s = 5.0
+    sc.down_cooldown_s = 10.0
+    sc.down_after = 3
+    sc.busy_enabled = False
+    for k, v in overrides.items():
+        setattr(sc, k, v)
+    return sc
+
+
+class TestAutoscaler:
+    """Deterministic units: ``tick(now=...)`` with a simulated clock and
+    stubbed signals — no threads, no sleeps."""
+
+    def test_scale_up_on_inflight_pressure_with_cooldown(self):
+        router, fleet = FakeSignalRouter(), FakeFleet(2)
+        sc = make_scaler(router, fleet)
+        router.sig["inflight"] = 20  # capacity 10×2 → pressure 1.0
+        assert sc.tick(now=100.0) == "up" and fleet.n == 3
+        # inside the up cooldown: pressure alone must not spawn again
+        assert sc.tick(now=102.0) == "hold" and fleet.n == 3
+        # cooldown expired: still hot → another replica
+        assert sc.tick(now=105.5) == "up" and fleet.n == 4
+        # hard max bound: never beyond max_replicas
+        assert sc.tick(now=120.0) == "hold" and fleet.n == 4
+        st = sc.stats()
+        assert st["scaleUps"] == 2 and st["scaleDowns"] == 0
+        assert st["signals"]["inflight"] == 1.0
+
+    def test_hysteresis_band_holds_and_resets_streak(self):
+        router, fleet = FakeSignalRouter(), FakeFleet(2)
+        sc = make_scaler(router, fleet)
+        router.sig["inflight"] = 2  # pressure 0.1 ≤ down threshold
+        sc.tick(now=10.0)
+        sc.tick(now=11.0)
+        assert sc.stats()["lowStreak"] == 2
+        # mid-band pressure: no decision AND the low streak resets
+        router.sig["inflight"] = 10  # pressure 0.5
+        assert sc.tick(now=12.0) == "hold"
+        assert sc.stats()["lowStreak"] == 0 and fleet.n == 2
+
+    def test_scale_down_needs_streak_then_cooldown(self):
+        router, fleet = FakeSignalRouter(admitted=3), FakeFleet(3)
+        sc = make_scaler(router, fleet)
+        assert sc.tick(now=10.0) == "hold"
+        assert sc.tick(now=11.0) == "hold"
+        # third consecutive low tick crosses down_after → drain one
+        assert sc.tick(now=12.0) == "down" and fleet.n == 2
+        # the down cooldown gates the next shrink even at zero pressure
+        for t in (13.0, 14.0, 15.0):
+            assert sc.tick(now=t) == "hold"
+        assert fleet.n == 2
+        # past the cooldown with the streak still low → shrink to min
+        assert sc.tick(now=23.0) == "down" and fleet.n == 1
+        # min bound: never below min_replicas
+        for t in (40.0, 41.0, 42.0, 43.0):
+            sc.tick(now=t)
+        assert fleet.n == 1
+
+    def test_roll_in_progress_holds_everything(self):
+        router, fleet = FakeSignalRouter(), FakeFleet(2)
+        sc = make_scaler(router, fleet)
+        router.sig["inflight"] = 20  # screaming hot
+        router.sig["rolling"] = True
+        # never fight a roll: drains look like load, restarts must not
+        # race a scale-down
+        assert sc.tick(now=50.0) == "hold" and fleet.n == 2
+        router.sig["rolling"] = False
+        assert sc.tick(now=51.0) == "up" and fleet.n == 3
+
+    def test_shed_rate_signal_uses_counter_deltas(self):
+        router, fleet = FakeSignalRouter(), FakeFleet(2)
+        sc = make_scaler(router, fleet, shed_ref=0.05)
+        router.sig["counters"] = {"ok": 100, "shed": 0}
+        sc.tick(now=10.0)  # baseline tick: deltas are zero
+        assert sc.stats()["signals"]["shed"] == 0.0
+        # 60 sheds over the next 100 requests: rate 0.6 ≫ shed_ref
+        router.sig["counters"] = {"ok": 140, "shed": 60}
+        assert sc.tick(now=11.0) == "up"
+        assert sc.stats()["signals"]["shed"] == 1.0
+
+    def test_below_min_heals_upward(self):
+        router, fleet = FakeSignalRouter(), FakeFleet(1)
+        sc = make_scaler(router, fleet, min_replicas=2)
+        assert sc.tick(now=10.0) == "up" and fleet.n == 2
+
+    def test_fleet_and_autoscaler_bridges_render(self):
+        from predictionio_tpu.obs import bridges as obs_bridges
+        from predictionio_tpu.obs import metrics as obs_metrics
+
+        router, fleet = FakeSignalRouter(), FakeFleet(2)
+        sc = make_scaler(router, fleet)
+        router.sig["inflight"] = 20
+        sc.tick(now=10.0)
+        reg = obs_metrics.MetricsRegistry()
+        obs_bridges.bridge_autoscaler(reg, sc.stats)
+        obs_bridges.bridge_fleet(reg, lambda: {
+            "replicas": 3, "alive": 2, "restarts": 5,
+            "backoffMs": {"http://r0": 200.0},
+            "transitions": {"up": 4, "down": 1},
+        })
+        series = obs_metrics.parse_prometheus(reg.render_prometheus())
+        assert series[("pio_autoscaler_replicas_target", ())] == 3
+        assert series[("pio_autoscaler_pressure", ())] == 1.0
+        assert series[
+            ("pio_autoscaler_signal", (("signal", "inflight"),))
+        ] == 1.0
+        assert series[
+            ("pio_autoscaler_scale_events_total", (("direction", "up"),))
+        ] == 1
+        assert series[("pio_autoscaler_last_decision", ())] == 1
+        assert series[("pio_fleet_replicas", ())] == 3
+        assert series[("pio_fleet_replicas_alive", ())] == 2
+        assert series[("pio_fleet_restarts_total", ())] == 5
+        assert series[
+            ("pio_fleet_transitions_total", (("direction", "down"),))
+        ] == 1
+        assert series[
+            ("pio_fleet_replica_backoff_ms", (("replica", "http://r0"),))
+        ] == 200.0
+
+
+# -- roll vs scale-down race (ISSUE 11 satellite) ------------------------------
+
+
+RACE_CHILD = """
+import os
+import threading
+from predictionio_tpu.common.http import HttpService, json_response
+
+svc = HttpService("racechild")
+
+@svc.route("GET", r"/readyz")
+def readyz(req):
+    return json_response(200, {
+        "status": "ready", "generation": 1,
+        "fastpathWarm": True, "draining": False,
+    })
+
+@svc.route("POST", r"/stop")
+def stop(req):
+    threading.Timer(0.2, os._exit, args=(0,)).start()
+    return json_response(202, {"stopping": True})
+
+svc.start("127.0.0.1", int(os.environ["FLEET_CHILD_PORT"]))
+svc.serve_forever()
+"""
+
+
+class TestRollVsScaleDownRace:
+    def _spawn(self):
+        import predictionio_tpu
+
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(predictionio_tpu.__file__))
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root] + ([env["PYTHONPATH"]]
+                           if env.get("PYTHONPATH") else [])
+        )
+
+        def spawn(port):
+            cenv = dict(env)
+            cenv["FLEET_CHILD_PORT"] = str(port)
+            return subprocess.Popen(
+                [sys.executable, "-c", RACE_CHILD], env=cenv,
+            )
+
+        return spawn
+
+    @staticmethod
+    def _ready(url):
+        try:
+            with urllib.request.urlopen(url + "/readyz", timeout=1) as r:
+                return r.status == 200
+        except OSError:
+            return False
+
+    def test_concurrent_roll_and_scale_down_no_double_stop(self):
+        """A roll() racing a remove_replica() must neither stop the same
+        process twice nor orphan a drained replica: whoever wins the ops
+        lock owns the process end to end, the loser skips or retires a
+        fully-rolled replica."""
+        fleet = FleetSupervisor(self._spawn(), free_ports(2))
+        fleet.stop_timeout_s = 5.0
+        fleet.roll_timeout_s = 30.0
+        fleet.start()
+        try:
+            for url in fleet.urls():
+                wait_until(
+                    lambda u=url: self._ready(u), timeout=30.0,
+                    msg=f"race child {url} never served /readyz",
+                )
+            removed = {}
+
+            def do_remove():
+                removed["slot"] = fleet.remove_replica()
+
+            t = threading.Thread(target=do_remove, daemon=True)
+            t.start()
+            report = fleet.roll()
+            t.join(30.0)
+            assert not t.is_alive()
+            # both operations completed without error
+            assert removed["slot"] is not None
+            assert report["ok"] is True
+            # exactly one replica survives, alive and untangled
+            st = fleet.status()
+            assert len(st["replicas"]) == 1
+            surv = st["replicas"][0]
+            assert surv["alive"] and not surv["removing"]
+            assert not surv["rolling"]
+            assert surv["url"] != removed["slot"]["url"]
+            # the retired process is really gone (nothing re-listens)
+            assert not self._ready(removed["slot"]["url"])
+            # the race resolves cleanly whichever side wins: removal
+            # before the roll's snapshot filters the slot out (1 entry);
+            # removal mid-roll makes the roll skip it (2 entries, one
+            # marked skipped); removal after leaves 2 plain entries.
+            # Whatever the interleaving, nothing is ever double-stopped.
+            assert len(report["replicas"]) in (1, 2)
+            for e in report["replicas"]:
+                if e.get("skipped"):
+                    assert e["url"] == removed["slot"]["url"]
+            assert st["transitions"]["down"] >= 1
+            # nothing removable left mid-roll is a clean None, not a crash
+            with fleet._lock:
+                fleet._procs[0].expected_down = True
+            assert fleet.remove_replica() is None
+            with fleet._lock:
+                fleet._procs[0].expected_down = False
+        finally:
+            fleet.stop()
+
+
 # -- kill-9 + rolling-deploy chaos (real query-server subprocesses) -----------
 
 
@@ -705,4 +1056,80 @@ class TestFleetChaos:
                 _, info, _ = call("GET", r["url"] + "/")
                 assert info["engineInstanceId"] == new_iid
         finally:
+            router.shutdown()
+
+    def test_autoscale_with_preemption_zero_client_failures(
+        self, fleet_env
+    ):
+        """The elastic acceptance line: under load the scaler grows the
+        fleet, a seeded ``crash:fleet:replica`` kill -9 lands while it
+        is scaling, and once the load stops the surge replica drains
+        back out — all with ZERO client-visible failures."""
+        router, fleet, base = _boot_fleet(fleet_env["child_env"], n=2)
+        # the per-replica cap stays at its default: even mid-kill, with
+        # one admitted survivor, six workers must never hit admission
+        scaler = Autoscaler(router, fleet)
+        scaler.interval_ms = 200.0
+        scaler.min_replicas = 2
+        scaler.max_replicas = 3
+        scaler.up_threshold = 0.005  # any sampled inflight reads as hot
+        scaler.down_threshold = 0.001
+        scaler.up_cooldown_s = 1.0
+        scaler.down_cooldown_s = 1.0
+        scaler.down_after = 2
+        scaler.busy_enabled = False
+        router.attach_autoscaler(scaler)
+        plan = faults.FaultPlan(
+            [faults.FaultRule(site=PREEMPT_SITE, kind="crash", times=1)],
+            seed=3,
+        )
+        try:
+            scaler.start()
+            load = _LoadGen(base)
+            load.start()
+            try:
+                wait_until(
+                    lambda: load.ok >= 30, timeout=30.0,
+                    msg="load never got going",
+                )
+                wait_until(
+                    lambda: len(fleet.status()["replicas"]) == 3,
+                    timeout=30.0, msg="scaler never grew the fleet",
+                )
+                # preemption mid-scale-up: the surge replica is still
+                # warming when the seeded kill fires on the next
+                # monitor tick
+                faults.install(plan)
+                wait_until(
+                    lambda: sum(
+                        r["fired"] for r in plan.stats()["rules"]
+                    ) >= 1,
+                    timeout=10.0, msg="preemption never fired",
+                )
+                # the supervisor respawns the victim; load stays on the
+                # whole time
+                wait_until(
+                    lambda: all(
+                        r["alive"] for r in fleet.status()["replicas"]
+                    ),
+                    timeout=30.0, msg="preempted replica never respawned",
+                )
+            finally:
+                load.stop()
+            assert load.failures == []  # THE acceptance line
+            assert load.ok > 100
+            assert scaler.stats()["scaleUps"] >= 1
+            # the crowd has passed: the surge replica drains back out
+            wait_until(
+                lambda: scaler.stats()["scaleDowns"] >= 1
+                and len(fleet.status()["replicas"]) == 2,
+                timeout=60.0, msg="scaler never drained the surge replica",
+            )
+            # /fleet surfaces the scaler's view
+            _, body, _ = call("GET", base + "/fleet")
+            assert body["autoscaler"]["scaleUps"] >= 1
+            assert body["autoscaler"]["minReplicas"] == 2
+        finally:
+            faults.clear()
+            scaler.stop()
             router.shutdown()

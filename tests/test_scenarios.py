@@ -1,0 +1,203 @@
+"""Traffic-scenario engine units (ISSUE 11).
+
+Everything up to the HTTP replay is pure math on a simulated clock —
+phase shapes, the compiled arrival schedule, skew dynamics, and the
+payload pre-draw are all deterministic given the seed, so these tests
+assert exact values without a single sleep.  One short live replay at
+the end proves the per-phase accounting end to end against a stub.
+"""
+
+import collections
+import json
+
+import pytest
+
+from predictionio_tpu.common.http import HttpService, Response, json_response
+from predictionio_tpu.tools.scenarios import (
+    MAX_ARRIVALS, Phase, ScenarioProgram, _build_payloads, parse_scenario,
+    run_scenario,
+)
+
+
+class TestScenarioDsl:
+    def test_parse_shapes_names_and_timeline(self):
+        program = parse_scenario(
+            "steady:name=calm,rate=20,duration=5;"
+            "flash:base=10,peak=100,at=2,hold=3,duration=10;"
+            "sine:base=8,amp=4,period=10,duration=20"
+        )
+        assert [ph.kind for ph in program.phases] == [
+            "steady", "flash", "sine"
+        ]
+        # explicit name wins, unnamed phases fall back to their kind
+        assert [ph.name for ph in program.phases] == [
+            "calm", "flash", "sine"
+        ]
+        assert program.duration_s == 35.0
+        desc = program.describe()
+        assert [(d["startS"], d["endS"]) for d in desc] == [
+            (0.0, 5.0), (5.0, 15.0), (15.0, 35.0)
+        ]
+        assert desc[0]["params"] == {"rate": 20.0}
+
+    def test_parse_rejects_bad_input(self):
+        with pytest.raises(ValueError, match="bad scenario token"):
+            parse_scenario("steady:rate")
+        with pytest.raises(ValueError, match="unknown scenario kind"):
+            parse_scenario("warp:rate=10")
+        with pytest.raises(ValueError, match="duration"):
+            parse_scenario("steady:rate=10,duration=0")
+        with pytest.raises(ValueError, match="at least one phase"):
+            ScenarioProgram([])
+
+
+class TestPhaseShapes:
+    def test_steady_and_ramp(self):
+        st = Phase("steady", 10.0, {"rate": 25.0})
+        assert st.rate_at(0.0) == st.rate_at(9.9) == 25.0
+        rp = Phase("ramp", 10.0, {"start": 0.0, "end": 10.0})
+        assert rp.rate_at(0.0) == 0.0
+        assert rp.rate_at(5.0) == 5.0
+        assert rp.rate_at(10.0) == 10.0  # clamped at the end
+
+    def test_sine_diurnal_with_floor(self):
+        sn = Phase("sine", 8.0, {"base": 10.0, "amp": 5.0, "period": 8.0})
+        assert sn.rate_at(0.0) == pytest.approx(10.0)
+        assert sn.rate_at(2.0) == pytest.approx(15.0)  # peak of the day
+        assert sn.rate_at(6.0) == pytest.approx(5.0)   # trough
+        # a trough deeper than the base floors at 0, never negative
+        deep = Phase("sine", 8.0, {"base": 1.0, "amp": 10.0, "period": 8.0})
+        assert deep.rate_at(6.0) == 0.0
+
+    def test_flash_crowd_step(self):
+        fl = Phase("flash", 10.0, {
+            "base": 10.0, "peak": 100.0, "at": 2.0, "hold": 3.0,
+        })
+        assert fl.rate_at(1.9) == 10.0
+        assert fl.rate_at(2.0) == 100.0
+        assert fl.rate_at(4.9) == 100.0
+        assert fl.rate_at(5.0) == 10.0  # crowd dispersed
+        # defaults: peak = 10 × base
+        assert Phase("flash", 9.0, {"base": 7.0}).rate_at(4.0) == 70.0
+
+    def test_zipf_drift_and_mix_interpolate(self):
+        zd = Phase("zipfdrift", 10.0, {"s0": 1.0, "s1": 2.0})
+        assert zd.zipf_s_at(0.0) == 1.0
+        assert zd.zipf_s_at(5.0) == 1.5
+        assert zd.zipf_s_at(15.0) == 2.0  # clamped past the end
+        assert zd.mix_at(5.0) is None
+        mx = Phase("mixshift", 10.0, {"from": 0.9, "to": 0.1})
+        assert mx.mix_at(0.0) == pytest.approx(0.9)
+        assert mx.mix_at(5.0) == pytest.approx(0.5)
+        assert mx.mix_at(10.0) == pytest.approx(0.1)
+        assert mx.zipf_s_at(5.0) is None
+        # a non-drifting phase can still pin a static zipf exponent
+        assert Phase("steady", 5.0, {"zipf_s": 1.3}).zipf_s_at(2.0) == 1.3
+
+
+class TestArrivalSchedule:
+    def test_arrivals_deterministic_and_phase_tagged(self):
+        program = parse_scenario(
+            "steady:rate=10,duration=2;steady:rate=5,duration=2"
+        )
+        a1 = program.arrivals()
+        assert a1 == program.arrivals()  # pure math, no clock reads
+        # ~20 arrivals at 10 rps then ~10 at 5 rps (float step slack ±1)
+        assert 28 <= len(a1) <= 31
+        times = [t for t, _ in a1]
+        assert times == sorted(times) and times[0] == 0.0
+        by_phase = collections.Counter(i for _, i in a1)
+        assert 19 <= by_phase[0] <= 21 and 9 <= by_phase[1] <= 11
+        # every phase-1 arrival is stamped after the phase boundary
+        assert all(t >= 2.0 for t, i in a1 if i == 1)
+
+    def test_zero_rate_idles_without_emitting(self):
+        program = parse_scenario(
+            "steady:rate=0,duration=1;steady:rate=10,duration=1"
+        )
+        arrivals = program.arrivals()
+        assert arrivals and all(i == 1 for _, i in arrivals)
+        assert all(t >= 1.0 for t, _ in arrivals)
+
+    def test_runaway_rate_fails_loudly(self):
+        program = parse_scenario("steady:rate=1000000,duration=10")
+        with pytest.raises(ValueError, match=str(MAX_ARRIVALS)):
+            program.arrivals()
+
+
+class TestPayloadPredraw:
+    def test_without_samples_every_body_is_the_query(self):
+        program = parse_scenario("steady:rate=10,duration=1")
+        arrivals = program.arrivals()
+        payloads = _build_payloads(
+            program, arrivals, {"user": "u1", "num": 3}, None, 0, 50.0
+        )
+        assert len(payloads) == len(arrivals)
+        assert set(payloads) == {json.dumps({"user": "u1", "num": 3}).encode()}
+
+    def test_mix_share_routes_tenant_halves(self):
+        users = [f"u{i}" for i in range(10)]
+        program = parse_scenario("mixshift:rate=50,from=1,to=1,duration=1")
+        arrivals = program.arrivals()
+        payloads = _build_payloads(
+            program, arrivals, {"num": 3}, {"user": users}, 5, 50.0
+        )
+        # share pinned at 1.0: every request lands on the FIRST half
+        seen = {json.loads(p)["user"] for p in payloads}
+        assert seen and seen <= set(users[:5])
+        # same seed → identical schedule; different seed → different draw
+        again = _build_payloads(
+            program, arrivals, {"num": 3}, {"user": users}, 5, 50.0
+        )
+        assert payloads == again
+
+    def test_zipf_schedule_skews_toward_head_keys(self):
+        users = [f"u{i}" for i in range(10)]
+        program = parse_scenario("zipfdrift:rate=200,s0=2,s1=2,duration=1")
+        arrivals = program.arrivals()
+        payloads = _build_payloads(
+            program, arrivals, {"num": 3}, {"user": users}, 7, 50.0
+        )
+        counts = collections.Counter(json.loads(p)["user"] for p in payloads)
+        # s=2 concentrates hard on the head of the key list
+        assert counts["u0"] > len(arrivals) / 10
+        assert counts["u0"] >= counts["u9"]
+
+
+class TestLiveReplayAccounting:
+    def test_per_phase_slo_accounting_against_stub(self):
+        """One short open-loop replay: 200s and alternating 503s must
+        land in the right phase buckets, and the SLO verdict must AND
+        across phases."""
+        hits = {"n": 0}
+        svc = HttpService("scenariostub")
+
+        @svc.route("POST", r"/queries\.json")
+        def queries(req):
+            hits["n"] += 1
+            if hits["n"] % 3 == 0:
+                return Response(status=503, body={"message": "shed"},
+                                headers={"Retry-After": "1"})
+            return json_response(200, {"ok": True})
+
+        port = svc.start("127.0.0.1", 0)
+        try:
+            program = parse_scenario(
+                "steady:name=a,rate=30,duration=0.4;"
+                "steady:name=b,rate=30,duration=0.4"
+            )
+            res = run_scenario(
+                f"http://127.0.0.1:{port}", {"user": "u1", "num": 1},
+                program, concurrency=4, slo_p99_ms=5000.0,
+            )
+        finally:
+            svc.stop()
+        assert res["requests"] == len(program.arrivals())
+        assert res["errors"] == 0
+        assert res["shed"] >= 1
+        assert res["ok"] + res["shed"] == res["requests"]
+        assert [p["name"] for p in res["phases"]] == ["a", "b"]
+        for p in res["phases"]:
+            assert p["ok"] + p["shed"] == p["offered"]
+            assert p["sloHeld"] is True
+        assert res["sloHeld"] is True

@@ -33,7 +33,7 @@ OUT = os.path.join(REPO, "BENCH_TPU_MANUAL.json")
 _PIN = {"BENCH_REBALANCE": "1", "BENCH_DTYPE": "f32"}
 _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
          "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0",
-         "BENCH_KERNEL": "0", "BENCH_FLEET": "0"}
+         "BENCH_KERNEL": "0", "BENCH_FLEET": "0", "BENCH_ELASTIC": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -223,6 +223,23 @@ def main() -> int:
             isinstance(hedge_ratio, (int, float)) and hedge_ratio <= 0.5
             and roll.get("client_errors") == 0
         ),
+    }
+    # elastic gate (ISSUE 11): "SLO held while scaling" — a flash-crowd
+    # scenario with a seeded mid-surge replica kill -9 must finish with
+    # zero client-visible errors and flash-phase p99 within SLO, AND the
+    # autoscaler must have both grown and drained the fleet, AND the
+    # preemption must actually have fired (a chaos run where the kill
+    # never landed proves nothing)
+    ela = primary.get("elastic") or {}
+    artifact["fleet"]["elastic"] = {
+        "p99_while_scaling_ms": ela.get("p99_while_scaling_ms"),
+        "slo_p99_ms": ela.get("slo_p99_ms"),
+        "client_errors": ela.get("client_errors"),
+        "shed": ela.get("shed"),
+        "scale_ups": ela.get("scale_ups"),
+        "scale_downs": ela.get("scale_downs"),
+        "preemptions": ela.get("preemptions"),
+        "gate_pass": ela.get("gate_pass"),
     }
     # static-analysis gate: perf numbers from a repo carrying hot-path or
     # race hazards are not publishable — `pio analyze` must report zero
