@@ -1579,6 +1579,111 @@ def _elastic_bench(ctx) -> dict:
     return out
 
 
+def _sharded_serving_bench(ctx) -> dict:
+    """Sharded-serving evidence (ISSUE 12): on the multi-device mesh, a
+    catalog deliberately sized past one device's (simulated) HBM budget is
+    served through the :class:`ShardingPlan` partitioned fast path under a
+    Zipf workload.
+
+    Gates: (a) the catalog really overflows the per-device budget while
+    every shard's resident block fits it, (b) sharded answers are
+    BIT-IDENTICAL to the replicated reference (indices and values), (c)
+    per-shard utilization is non-null, and (d) the popularity-aware plan's
+    max/min attributed busy-fraction balance stays ≤ 1.5.  The naive
+    round-robin plan serves the same workload and reports its balance for
+    comparison, ungated — with hot items at contiguous low ids it can land
+    anywhere; the LPT plan cannot.
+    """
+    from predictionio_tpu.serving import sharding as sharding_mod
+    from predictionio_tpu.serving.fastpath import BucketedScorer
+
+    n_items = int(os.environ.get("BENCH_SHARD_ITEMS", 4096))
+    rank = int(os.environ.get("BENCH_SHARD_RANK", 16))
+    budget = int(os.environ.get("BENCH_SHARD_BUDGET", 70_000))
+    n_req = int(os.environ.get("BENCH_SHARD_REQUESTS", 640))
+    n_users = 512
+    k = 20
+    rng = np.random.default_rng(12)
+    U = rng.normal(size=(n_users, rank)).astype(np.float32)
+    V = rng.normal(size=(n_items, rank)).astype(np.float32)
+    catalog_bytes = int(V.nbytes)
+    users = _sample_ids(rng, n_users, n_req, "zipf", s=1.1)
+
+    # replicated reference: the ground truth answers AND the measured
+    # per-item win counts the popularity plan balances (the live analogue
+    # of the publish-time factor-norm proxy)
+    repl = BucketedScorer(ctx, U, V, max_k=k, sharding="replicated")
+    ref_idx, ref_val = repl.score_topk(users, k)
+    wins = np.bincount(
+        ref_idx.reshape(-1), minlength=n_items
+    ).astype(np.float64)
+
+    n_shards = sharding_mod.shard_count_for_budget(
+        n_items, rank * 4.0, budget
+    )
+    plans = {
+        name: sharding_mod.build_plan(
+            n_items, n_shards, weights=wins, strategy=name,
+            capacity_budget_bytes=budget,
+        )
+        for name in ("popularity", "round_robin")
+    }
+    per_plan: dict = {}
+    exact = True
+    busy_ok = True
+    resident_fits = True
+    for name, plan in plans.items():
+        sc = BucketedScorer(ctx, U, V, max_k=k, plan=plan, sharding="sharded")
+        idx, vals = sc.score_topk(users, k)
+        eq = bool(
+            np.array_equal(idx, ref_idx) and np.array_equal(vals, ref_val)
+        )
+        exact = exact and eq
+        st = (sc.stats() or {}).get("sharding") or {}
+        busy = st.get("busy_fraction")
+        busy_ok = busy_ok and bool(
+            busy and all(b is not None for b in busy)
+        )
+        resident = st.get("resident_bytes") or []
+        resident_fits = resident_fits and bool(
+            resident and max(resident) <= budget
+        )
+        balance = (
+            round(max(busy) / min(busy), 4)
+            if busy and min(busy) > 0 else None
+        )
+        per_plan[name] = {
+            "fingerprint": plan.fingerprint,
+            "exact_match": eq,
+            "busy_fraction": busy,
+            "busy_balance": balance,
+            "result_share": st.get("result_share"),
+            "resident_bytes_per_shard": resident,
+            "merge_bytes": st.get("merge_bytes"),
+        }
+    pop_balance = per_plan["popularity"]["busy_balance"]
+    return {
+        "n_items": n_items,
+        "rank": rank,
+        "k": k,
+        "requests": int(n_req),
+        "distribution": "zipf",
+        "catalog_bytes": catalog_bytes,
+        "per_device_budget_bytes": budget,
+        "n_shards": n_shards,
+        "plans": per_plan,
+        "gate_pass": bool(
+            catalog_bytes > budget
+            and n_shards > 1
+            and resident_fits
+            and exact
+            and busy_ok
+            and pop_balance is not None
+            and pop_balance <= 1.5
+        ),
+    }
+
+
 def main() -> None:
     # BENCH_PLATFORM=cpu skips the (slow) tunnel probe for local iteration
     forced_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
@@ -1782,6 +1887,15 @@ def main() -> None:
             print(f"WARNING: elastic bench failed: {e}", file=sys.stderr)
             elastic = {"error": str(e)}
         print(f"INFO: elastic: {elastic}", file=sys.stderr)
+    sharded = None
+    if os.environ.get("BENCH_SHARDED", "1") != "0":
+        try:
+            sharded = _sharded_serving_bench(ctx)
+        except Exception as e:  # the sharding bench must never kill the artifact
+            print(f"WARNING: sharded serving bench failed: {e}",
+                  file=sys.stderr)
+            sharded = {"error": str(e)}
+        print(f"INFO: sharded_serving: {sharded}", file=sys.stderr)
     record = {
         "metric": "als_train_events_per_sec_per_chip",
         "value": round(value, 1),
@@ -1824,6 +1938,8 @@ def main() -> None:
         record["fleet"] = fleet
     if elastic is not None:
         record["elastic"] = elastic
+    if sharded is not None:
+        record["multichip"] = {"sharded_serving": sharded}
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

@@ -1,15 +1,20 @@
 """Blocking-call detector for the serving dispatch hot loop.
 
-The micro-batcher worker (``serving/batching.py``) and the fastpath
-scorer (``serving/fastpath.py``) sit between every query and the TPU:
-one ``time.sleep``, ``fsync``, JSON round-trip, or synchronous network
+The micro-batcher worker (``serving/batching.py``), the fastpath
+scorer (``serving/fastpath.py``), and the shard fan-out/merge layer
+(``serving/sharding.py``) sit between every query and the TPU: one
+``time.sleep``, ``fsync``, JSON round-trip, or synchronous network
 call there is paid by the whole batch at p50, not by one request at
 p99.  Serialization belongs at the HTTP layer, durability in the WAL's
 group-commit thread, and pacing in the condition-variable waits the
 batcher already uses.
 
 Scope: every function in the dispatch modules except constructors and
-teardown (``__init__``/``_compile``/``stats``/``stop``/``close``), plus
+teardown (``__init__``/``_compile``/``stats``/``stop``/``close``) and
+the publish-time plan builders (``build_plan``/``save_plan``/
+``load_plan``/``plan_from_env``/``build_layout``/``to_payload``/
+``from_payload``/``describe`` — they run at train/rebalance time, never
+under a dispatch, and the sealed-blob write MUST fsync), plus
 worker-loop functions (``_loop``/``_run``/``_flush``/``_drain``/
 ``_health_loop``/``_monitor_loop``/``_control_loop`` — the last three
 are the fleet router's health prober, the fleet supervisor's child
@@ -36,9 +41,16 @@ R_BLOCKING = rule(
 )
 
 # dispatch modules: every function is hot unless exempted
-_HOT_MODULES = ("batching.py", "fastpath.py")
+_HOT_MODULES = ("batching.py", "fastpath.py", "sharding.py")
 _EXEMPT_FUNCS = {"__init__", "_compile", "stats", "stop", "close",
-                 "__repr__"}
+                 "__repr__",
+                 # sharding.py publish/rebalance-time plan machinery:
+                 # runs at train or `pio shards rebuild` time, never
+                 # under a dispatch (ShardAccounting.note/snapshot and
+                 # ShardLayout.take_rows stay in scope)
+                 "build_plan", "save_plan", "load_plan", "plan_from_env",
+                 "build_layout", "to_payload", "from_payload",
+                 "describe", "validate", "shard_count_for_budget"}
 # worker-loop functions checked across the wider threaded scope
 # (_health_loop/_monitor_loop/_control_loop: the router's probe pacer,
 # the fleet supervisor's child watcher, and the autoscaler's decision

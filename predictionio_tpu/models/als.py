@@ -121,6 +121,11 @@ class ALSModel:
     user_scale: Optional[np.ndarray] = None
     item_factors_q: Optional[np.ndarray] = None
     item_scale: Optional[np.ndarray] = None
+    # publish-time ShardingPlan (serving/sharding.py), declared when the
+    # PIO_SHARD_* knobs ask for item-factor partitioning; None serves
+    # replicated. Travels inside the sealed MODELDATA pickle (auto mode)
+    # or as its own sealed plan.blob (checkpoint mode).
+    sharding_plan: Optional[object] = None
 
     def predict_rating(self, user_idx: int, item_idx: int) -> float:
         return float(self.user_factors[user_idx] @ self.item_factors[item_idx])
@@ -814,13 +819,13 @@ def train_als(
     # return in original id order so the model is permutation-invisible
     U_host = U_all[u_perm[:n_users]] if u_perm is not None else U_all[:n_users]
     V_host = V_all[i_perm[:n_items]] if i_perm is not None else V_all[:n_items]
-    return ALSModel(
+    return _declare_sharding_plan(ALSModel(
         user_factors=U_host,
         item_factors=V_host,
         user_map=interactions.user_map,
         item_map=interactions.item_map,
         config=cfg,
-    )
+    ))
 
 
 def _dense_blocks_for(interactions, cfg: ALSConfig, n_shards: int):
@@ -1092,13 +1097,43 @@ def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
         # its exchange long ago, so the rendezvous blobs can go
         sh.cleanup()
     n_users, n_items = sh.n_users, sh.n_items
-    return ALSModel(
+    return _declare_sharding_plan(ALSModel(
         user_factors=U_all[u_perm[:n_users]],
         item_factors=V_all[i_perm[:n_items]],
         user_map=sh.user_map,
         item_map=sh.item_map,
         config=cfg,
-    )
+    ))
+
+
+def _declare_sharding_plan(model: ALSModel) -> ALSModel:
+    """Publish-time sharding declaration (PIO_SHARD_* knobs; no-op unset).
+
+    Weights for the popularity strategy default to the item-factor L2
+    norms — the train-time proxy for expected traffic (implicit-ALS
+    norms grow with interaction mass); a live deployment can rebalance
+    from measured hot-set traffic via ``pio shards rebuild``.
+    """
+    from predictionio_tpu.serving import sharding as _sharding
+
+    try:
+        plan = _sharding.plan_from_env(
+            model.item_factors.shape[0],
+            weights=np.linalg.norm(model.item_factors, axis=1),
+            bytes_per_item=float(model.item_factors.shape[1]) * 4.0,
+        )
+    except ValueError as e:
+        logger.warning(
+            "sharding plan declaration failed (%s); publishing unsharded", e
+        )
+        return model
+    if plan is not None:
+        model.sharding_plan = plan
+        logger.info(
+            "declared sharding plan %s: %d shards (%s)",
+            plan.fingerprint, plan.n_shards, plan.strategy,
+        )
+    return model
 
 
 class CheckpointedALSModel(ALSModel):
@@ -1138,13 +1173,42 @@ class CheckpointedALSModel(ALSModel):
         )
         if distributed.should_write_storage():
             quant_meta = self._publish_quantized(d)
+            shard_meta = self._publish_plan(d)
             with open(os.path.join(d, "maps.pkl"), "wb") as f:
                 pickle.dump(
                     {"user_map": self.user_map, "item_map": self.item_map,
-                     "config": self.config, "quant": quant_meta},
+                     "config": self.config, "quant": quant_meta,
+                     "sharding": shard_meta},
                     f,
                 )
         return True  # manifest mode: MODELDATA stores only the class path
+
+    def _publish_plan(self, d: str) -> dict:
+        """Seal the declared ShardingPlan beside the factors (plan.blob).
+
+        The manifest record carries the plan fingerprint so deploy can
+        verify the blob it opens is the partition this model generation
+        was published with — a rebalance that reseals plan.blob also
+        rewrites the record, atomically per artifact.  No plan → record
+        ``n_shards: 0`` and serving stays replicated.
+        """
+        import os
+
+        from predictionio_tpu.serving import sharding as _sharding
+
+        plan = getattr(self, "sharding_plan", None)
+        if plan is None:
+            return {"n_shards": 0}
+        _sharding.save_plan(os.path.join(d, "plan.blob"), plan)
+        logger.info(
+            "sharding plan sealed: %d shards (%s), fingerprint %s",
+            plan.n_shards, plan.strategy, plan.fingerprint,
+        )
+        return {
+            "n_shards": plan.n_shards,
+            "strategy": plan.strategy,
+            "fingerprint": plan.fingerprint,
+        }
 
     def _publish_quantized(self, d: str) -> dict:
         """Offline quantize step at model publish (PIO_QUANT_DTYPE).
@@ -1228,7 +1292,46 @@ class CheckpointedALSModel(ALSModel):
             config=meta["config"],
         )
         cls._load_quantized(model, d, meta.get("quant") or {})
+        cls._load_plan(model, d, meta.get("sharding") or {})
         return model
+
+    @staticmethod
+    def _load_plan(model: "CheckpointedALSModel", d: str, rec: dict) -> None:
+        """Attach the published ShardingPlan, degrading on any damage.
+
+        A torn/missing plan.blob, a checksum mismatch, or a fingerprint
+        that disagrees with the manifest all log a warning and leave
+        ``sharding_plan`` unset — the server cold-starts replicated (the
+        LKG machinery never sees a failure), because the plan is an
+        optimization, never a single point of failure.
+        """
+        import os
+        import pickle
+
+        from predictionio_tpu.core import persistence as _persistence
+        from predictionio_tpu.serving import sharding as _sharding
+
+        if not rec or not rec.get("n_shards"):
+            return
+        try:
+            plan = _sharding.load_plan(os.path.join(d, "plan.blob"))
+            want = rec.get("fingerprint")
+            if want and plan.fingerprint != want:
+                raise _persistence.ModelIntegrityError(
+                    f"plan fingerprint {plan.fingerprint} != manifest {want}"
+                )
+            model.sharding_plan = plan
+            logger.info(
+                "loaded sharding plan %s: %d shards (%s)",
+                plan.fingerprint, plan.n_shards, plan.strategy,
+            )
+        except (
+            _persistence.ModelIntegrityError, OSError, KeyError,
+            pickle.UnpicklingError, EOFError, ValueError,
+        ) as e:
+            logger.warning(
+                "sharding plan unavailable (%s); serving replicated", e
+            )
 
     @staticmethod
     def _load_quantized(model: "CheckpointedALSModel", d: str, quant: dict):
@@ -1388,6 +1491,9 @@ class ALSScorer:
 
                     m = self.model
                     dtype = getattr(m, "factor_dtype", "f32")
+                    # publish-time ShardingPlan (if declared) selects the
+                    # sharded factor placement per PIO_SERVING_SHARDING
+                    plan = getattr(m, "sharding_plan", None)
                     if dtype != "f32" and m.user_factors_q is not None:
                         # published quantized variant: device-resident
                         # narrow factors, dequantized in-kernel
@@ -1399,6 +1505,7 @@ class ALSScorer:
                             factor_dtype=dtype,
                             user_scale=m.user_scale,
                             item_scale=m.item_scale,
+                            plan=plan,
                         )
                     else:
                         fp = BucketedScorer(
@@ -1406,6 +1513,7 @@ class ALSScorer:
                             m.user_factors,
                             m.item_factors,
                             max_k=max_k or self.max_k,
+                            plan=plan,
                         )
                     self._fastpath = fp
         return fp

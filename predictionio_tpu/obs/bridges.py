@@ -241,6 +241,115 @@ def bridge_fastpath(
     registry.register_collector(collect)
 
 
+# -- serving: sharded factor placement ---------------------------------------
+
+def bridge_sharding(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """Sharded-serving accounting → pio_shard_* series.
+
+    Emits nothing while the scorer serves replicated (no ``sharding``
+    block in its stats), so the family set appears exactly when a
+    ShardingPlan is live.  ``pio_shard_busy_fraction`` is an ATTRIBUTED
+    quantity — the measured whole-mesh busy fraction apportioned across
+    shards by realized result-load share (docs/operations.md, "Sharded
+    serving") — because one SPMD dispatch keeps every shard busy
+    simultaneously; the max/min balance alerts care about is exactly the
+    share imbalance this preserves.
+    """
+
+    def collect():
+        s = stats_fn()
+        sh = (s or {}).get("sharding")
+        if not isinstance(sh, dict):
+            return []
+        plan = sh.get("plan") or {}
+        n = int(_num(plan.get("n_shards")))
+
+        def per_shard(values, cast=_num):
+            vals = values if isinstance(values, list) else []
+            return [
+                ("", (("shard", str(i)),), cast(v))
+                for i, v in enumerate(vals[:n])
+            ]
+
+        fams = [
+            _fam(
+                "pio_shard_info", "gauge",
+                "Active sharding plan (info gauge; value is the shard "
+                "count, labels carry the plan identity).",
+                [(
+                    "",
+                    (
+                        ("fingerprint", str(plan.get("fingerprint", ""))),
+                        ("strategy", str(plan.get("strategy", ""))),
+                    ),
+                    float(n),
+                )],
+            ),
+            _fam(
+                "pio_shard_items", "gauge",
+                "Catalog items assigned to each shard by the plan.",
+                per_shard(plan.get("items_per_shard")),
+            ),
+            _fam(
+                "pio_shard_resident_bytes", "gauge",
+                "Device-resident item-factor bytes per shard (padded "
+                "block; must fit the per-shard HBM budget).",
+                per_shard(sh.get("resident_bytes")),
+            ),
+            _fam(
+                "pio_shard_queries_routed_total", "counter",
+                "Query rows fanned out to each shard (every shard scores "
+                "every row of every dispatch).",
+                per_shard(sh.get("queries_routed")),
+            ),
+            _fam(
+                "pio_shard_result_wins_total", "counter",
+                "Top-k result slots won by each shard's items — the "
+                "realized popularity load the plan balances.",
+                per_shard(sh.get("result_wins")),
+            ),
+            _fam(
+                "pio_shard_load_share", "gauge",
+                "Expected per-shard traffic share the plan was balanced "
+                "with (build-time weights).",
+                per_shard(plan.get("load_share")),
+            ),
+            _fam(
+                "pio_shard_result_share", "gauge",
+                "Realized per-shard share of returned top-k slots.",
+                per_shard(sh.get("result_share")),
+            ),
+            _fam(
+                "pio_shard_merge_bytes_total", "counter",
+                "Cumulative cross-shard merge collective payload "
+                "(all-gathered leaderboard bytes; see perf_roofline.md).",
+                [("", (), _num(sh.get("merge_bytes")))],
+            ),
+            _fam(
+                "pio_shard_merge_seconds_total", "counter",
+                "Device wall attributed to the merge collective (modeled "
+                "as the merge-byte share of each dispatch).",
+                [("", (), _num(sh.get("merge_seconds")))],
+            ),
+        ]
+        busy = sh.get("busy_fraction")
+        if isinstance(busy, list):
+            fams.append(
+                _fam(
+                    "pio_shard_busy_fraction", "gauge",
+                    "Measured window busy fraction attributed across "
+                    "shards by realized result-load share; max/min is "
+                    "the balance the bench gates on.",
+                    per_shard(busy),
+                )
+            )
+        return fams
+
+    registry.register_collector(collect)
+
+
 # -- serving: device-utilization accountant ----------------------------------
 
 def bridge_devprof(

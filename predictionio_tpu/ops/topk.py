@@ -71,6 +71,26 @@ def top_k_with_mask(scores: jax.Array, k: int, mask: jax.Array | None = None):
     return jax.lax.top_k(scores, k)
 
 
+def merge_topk(
+    values: jax.Array, indices: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Merge per-shard leaderboards into a global top-k.
+
+    ``values``/``indices`` are ``(B, M)`` candidate rows — the
+    concatenation of every shard's local ``(B, local_k)`` leaderboard,
+    carrying GLOBAL item indices.  Rows are re-ranked by
+    ``(value desc, index asc)`` via a two-key stable sort, which is
+    exactly ``lax.top_k``'s tie order (smallest index wins), so a merge
+    over any shard partition returns bit-identical winners to a single
+    ``top_k`` over the full score row — including ties that span shards.
+    Returns ``(values (B, k), indices (B, k))``.
+    """
+    neg_vals, idx = jax.lax.sort(
+        (-values, indices.astype(jnp.int32)), num_keys=2
+    )
+    return -neg_vals[:, :k], idx[:, :k]
+
+
 def _dequantize(F: jax.Array, scale: Optional[jax.Array]) -> jax.Array:
     """XLA-side dequantize: the f32 math the fused kernel does in VMEM."""
     if F.dtype != jnp.float32:

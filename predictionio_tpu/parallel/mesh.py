@@ -167,6 +167,27 @@ class MeshContext:
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
+    def submesh(self, n_devices: int, axis: str = DATA_AXIS) -> "MeshContext":
+        """A context over the first ``n_devices`` devices, one ``axis``.
+
+        Sharded serving places a ShardingPlan of S shards on an S-device
+        1-D mesh; when the plan is narrower than the full mesh this carves
+        the prefix (devices-major order keeps the slice ICI-contiguous).
+        ``n_devices == mesh.size`` with a matching 1-D mesh returns self.
+        """
+        if n_devices == self.mesh.size and self.mesh.axis_names == (axis,):
+            return self
+        if n_devices > self.mesh.size:
+            raise ValueError(
+                f"submesh of {n_devices} devices from a {self.mesh.size}-"
+                "device mesh"
+            )
+        devs = list(self.mesh.devices.flat)[:n_devices]
+        return MeshContext(
+            mesh=make_mesh(axes={axis: n_devices}, devices=devs),
+            conf=dict(self.conf),
+        )
+
     def shard_rows(self, x, axis: str = DATA_AXIS):
         """Place array with dim 0 sharded over ``axis`` (pads to divisible)."""
         import jax.numpy as jnp

@@ -17,10 +17,23 @@ latency on live traffic.  This module removes both costs:
   impossible on the serve path, and :meth:`BucketedScorer.stats` exposes
   the compile/hit counters that prove it.
 
-The factor matrices are placed replicated on the mesh ONCE and stay
-resident in device memory between queries (Cloudburst's model-next-to-
-compute rule, arXiv:2007.05832); per-call traffic is the (B,) user-index
-upload and the (B, k) result readback.
+Factor placement is backend-dependent and happens ONCE at construction
+(Cloudburst's model-next-to-compute rule, arXiv:2007.05832); per-call
+traffic is the (B,) user-index upload and the (B, k) result readback.
+``PIO_SERVING_SHARDING`` selects between two placements:
+
+* **replicated** — a full copy of the factor matrices on every device;
+  the catalog is capped at a single chip's HBM.
+* **sharded** — item factors PARTITIONED across the mesh per an explicit
+  :class:`~predictionio_tpu.serving.sharding.ShardingPlan`: each query
+  fans out, every shard runs the same fused ``gather_score_topk`` over
+  only its local item block, and one small all-gather of per-shard
+  (B, local_k) leaderboards plus an on-device two-key merge
+  (``ops.topk.merge_topk``) yields answers bit-identical to the
+  replicated reference — the (B, n_items) score matrix never crosses a
+  link.  ``auto`` (the default) serves sharded only when the model
+  declares a plan AND the mesh has the devices for it, so every existing
+  caller keeps replicated behavior unchanged.
 
 HOT-SET PATH (``PIO_HOTSET_SIZE``, off by default): ALS scores are static
 between reloads — a hot user's top-k is the SAME answer every time until
@@ -36,6 +49,7 @@ working set track traffic drift.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -47,9 +61,16 @@ import numpy as np
 from predictionio_tpu.obs import devprof as _devprof
 from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.ops import score_kernel as _score_kernel
-from predictionio_tpu.ops.topk import gather_score_topk, resolve_backend
-from predictionio_tpu.parallel.mesh import MeshContext, pad_to_multiple
+from predictionio_tpu.ops.topk import (
+    gather_score_topk, merge_topk, resolve_backend,
+)
+from predictionio_tpu.parallel.mesh import (
+    DATA_AXIS, MeshContext, pad_to_multiple, shard_map,
+)
+from predictionio_tpu.serving import sharding as _sharding
 from predictionio_tpu.utils import profiling as _profiling
+
+logger = logging.getLogger(__name__)
 
 # The batch-size ladder. Powers of two above a singleton lane: 1 serves the
 # trickle case with zero padding, 64 matches MicroBatcher's default
@@ -64,6 +85,56 @@ def bucket_for(n: int, buckets=BUCKETS) -> Optional[int]:
         if n <= b:
             return b
     return None
+
+
+SERVING_BACKENDS = ("replicated", "sharded", "auto")
+
+
+def resolve_serving_backend(
+    requested: Optional[str] = None,
+    *,
+    plan=None,
+    ctx: Optional[MeshContext] = None,
+) -> str:
+    """Resolve the factor placement: ``"replicated"`` or ``"sharded"``.
+
+    ``requested`` overrides ``PIO_SERVING_SHARDING`` (default ``auto``).
+    ``auto`` serves sharded only when a :class:`ShardingPlan` with more
+    than one shard is declared AND the mesh has at least that many
+    devices — on a 1-device mesh, or for any model without a plan, it is
+    exactly the replicated path, so existing callers see no behavior
+    change.  An explicit ``sharded`` without a plan is a configuration
+    error; a plan wider than the mesh degrades to replicated with a
+    warning (the plan is an optimization, never a point of failure).
+    """
+    req = (
+        requested or os.environ.get("PIO_SERVING_SHARDING") or "auto"
+    ).strip().lower()
+    if req not in SERVING_BACKENDS:
+        raise ValueError(
+            f"PIO_SERVING_SHARDING must be one of {SERVING_BACKENDS}, "
+            f"got {req!r}"
+        )
+    if req == "replicated":
+        return "replicated"
+    n_dev = ctx.n_devices if ctx is not None else 1
+    if req == "sharded":
+        if plan is None:
+            raise ValueError(
+                "PIO_SERVING_SHARDING=sharded requires a ShardingPlan "
+                "declared at publish (PIO_SHARD_COUNT/PIO_SHARD_HBM_BUDGET)"
+            )
+        if plan.n_shards > n_dev:
+            logger.warning(
+                "sharding plan wants %d shards but the mesh has %d "
+                "devices; serving replicated", plan.n_shards, n_dev,
+            )
+            return "replicated"
+        return "sharded"
+    # auto
+    if plan is not None and 1 < plan.n_shards <= n_dev:
+        return "sharded"
+    return "replicated"
 
 
 class BucketedScorer:
@@ -82,6 +153,8 @@ class BucketedScorer:
         user_scale: Optional[np.ndarray] = None,
         item_scale: Optional[np.ndarray] = None,
         backend: Optional[str] = None,
+        plan=None,
+        sharding: Optional[str] = None,
     ):
         self.ctx = ctx
         self.n_users = user_factors.shape[0]
@@ -92,44 +165,27 @@ class BucketedScorer:
         self.factor_dtype = factor_dtype
         if factor_dtype == "int8" and (user_scale is None or item_scale is None):
             raise ValueError("int8 factors require user_scale and item_scale")
-        if self.backend == "fused":
-            # the fused kernel streams the item matrix in fixed-size blocks
-            self._n_items_pad = _score_kernel.pad_block_items(self.n_items)
-        else:
-            self._n_items_pad = pad_to_multiple(self.n_items, 8)
         self.k = min(max_k, self.n_items)
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
-        self._repl = ctx.replicated()
-        pad_i = self._n_items_pad - self.n_items
+        # factor placement: replicated full copies, or item blocks
+        # partitioned per the publish-time ShardingPlan (PIO_SERVING_SHARDING)
+        self.plan = plan
+        self.sharding = resolve_serving_backend(sharding, plan=plan, ctx=ctx)
+        self._shard_acct: Optional[_sharding.ShardAccounting] = None
         if factor_dtype == "f32":
             user_factors = np.asarray(user_factors, np.float32)
             item_factors = np.asarray(item_factors, np.float32)
-        self._U = ctx.replicate(np.asarray(user_factors))
-        self._V = ctx.replicate(
-            np.pad(np.asarray(item_factors), ((0, pad_i), (0, 0)))
-        )
-        if factor_dtype == "int8":
-            self._Uscale = ctx.replicate(np.asarray(user_scale, np.float32))
-            self._Vscale = ctx.replicate(
-                np.pad(
-                    np.asarray(item_scale, np.float32),
-                    ((0, pad_i), (0, 0)),
-                    constant_values=1.0,
-                )
+        if self.sharding == "sharded":
+            self._init_sharded_placement(
+                user_factors, item_factors, user_scale, item_scale
+            )
+            self._shard_acct = _sharding.ShardAccounting(
+                self.plan, self._local_k
             )
         else:
-            self._Uscale = self._Vscale = None
-        self._item_pad_mask = ctx.replicate(
-            np.arange(self._n_items_pad) >= self.n_items
-        )
-        # everything the compiled programs take except the per-call indices
-        if factor_dtype == "int8":
-            self._static_args = (
-                self._U, self._V, self._Uscale, self._Vscale,
-                self._item_pad_mask,
+            self._init_replicated_placement(
+                user_factors, item_factors, user_scale, item_scale
             )
-        else:
-            self._static_args = (self._U, self._V, self._item_pad_mask)
         self.resident_factor_bytes = sum(
             int(a.nbytes)
             for a in (self._U, self._V, self._Uscale, self._Vscale)
@@ -170,6 +226,9 @@ class BucketedScorer:
         self.devprof = _devprof.DeviceUtilization(
             platform=jax.default_backend()
         )
+        # per-bucket annotated HBM bytes, kept host-side so the sharded
+        # merge-time attribution doesn't re-enter the accountant per call
+        self._cost_bytes: dict[int, float] = {}
         # AOT warmup: every rung compiled before the first request, then
         # executed once — a lazily-materialized kernel (Pallas included)
         # can never surface its first-dispatch cost under traffic
@@ -180,8 +239,122 @@ class BucketedScorer:
             jax.block_until_ready(self._fns[b](*self._static_args, dummy_idx))
             self.warmup_executions += 1
 
+    def _init_replicated_placement(
+        self, user_factors, item_factors, user_scale, item_scale
+    ) -> None:
+        """Full factor copies on every device (the pre-sharding layout)."""
+        ctx = self.ctx
+        if self.backend == "fused":
+            # the fused kernel streams the item matrix in fixed-size blocks
+            self._n_items_pad = _score_kernel.pad_block_items(self.n_items)
+        else:
+            self._n_items_pad = pad_to_multiple(self.n_items, 8)
+        self._repl = ctx.replicated()
+        pad_i = self._n_items_pad - self.n_items
+        self._U = ctx.replicate(np.asarray(user_factors))
+        self._V = ctx.replicate(
+            np.pad(np.asarray(item_factors), ((0, pad_i), (0, 0)))
+        )
+        if self.factor_dtype == "int8":
+            self._Uscale = ctx.replicate(np.asarray(user_scale, np.float32))
+            self._Vscale = ctx.replicate(
+                np.pad(
+                    np.asarray(item_scale, np.float32),
+                    ((0, pad_i), (0, 0)),
+                    constant_values=1.0,
+                )
+            )
+        else:
+            self._Uscale = self._Vscale = None
+        self._item_pad_mask = ctx.replicate(
+            np.arange(self._n_items_pad) >= self.n_items
+        )
+        # everything the compiled programs take except the per-call indices
+        if self.factor_dtype == "int8":
+            self._static_args = (
+                self._U, self._V, self._Uscale, self._Vscale,
+                self._item_pad_mask,
+            )
+        else:
+            self._static_args = (self._U, self._V, self._item_pad_mask)
+
+    def _init_sharded_placement(
+        self, user_factors, item_factors, user_scale, item_scale
+    ) -> None:
+        """Item factors partitioned across the plan's shard submesh.
+
+        Every shard's item block is padded to one common kernel-aligned
+        capacity so the concatenated (S·cap_pad, rank) matrix shards
+        evenly over the mesh 'data' axis; per-slot global ids and a pad
+        mask ride alongside.  ``_n_items_pad`` becomes the PER-DEVICE
+        block size — each device scores only its shard, which is the
+        whole point — so the devprof cost annotation stays per-device
+        truthful.  User factors and the (B,) query indices are replicated
+        (users were never the HBM problem; items are).
+        """
+        import jax.numpy as jnp
+
+        plan = self.plan
+        plan.validate(self.n_items)
+        if self.backend == "fused":
+            pad_to = _score_kernel.pad_block_items
+        else:
+            def pad_to(n):
+                return pad_to_multiple(n, 8)
+        layout = _sharding.build_layout(plan, pad_to)
+        self._shard_layout = layout
+        self._n_items_pad = layout.cap_pad
+        # per-shard leaderboard width: a shard with fewer than k real
+        # items simply contributes its whole block; S·local_k ≥ k always
+        # holds because S·cap_pad ≥ n_items ≥ self.k
+        self._local_k = min(self.k, layout.cap_pad)
+        sc = self.ctx.submesh(plan.n_shards)
+        self._shard_ctx = sc
+        self._repl = sc.replicated()
+        rows = sc.sharding(DATA_AXIS, None)
+        flat = sc.sharding(DATA_AXIS)
+        self._U = jax.device_put(
+            jnp.asarray(np.asarray(user_factors)), self._repl
+        )
+        self._V = jax.device_put(
+            jnp.asarray(layout.take_rows(np.asarray(item_factors))), rows
+        )
+        if self.factor_dtype == "int8":
+            self._Uscale = jax.device_put(
+                jnp.asarray(np.asarray(user_scale, np.float32)), self._repl
+            )
+            self._Vscale = jax.device_put(
+                jnp.asarray(
+                    layout.take_rows(
+                        np.asarray(item_scale, np.float32), fill=1.0
+                    )
+                ),
+                rows,
+            )
+        else:
+            self._Uscale = self._Vscale = None
+        self._shard_gid = jax.device_put(jnp.asarray(layout.gid), flat)
+        self._item_pad_mask = jax.device_put(
+            jnp.asarray(layout.pad_mask), flat
+        )
+        if self.factor_dtype == "int8":
+            self._static_args = (
+                self._U, self._V, self._Uscale, self._Vscale,
+                self._shard_gid, self._item_pad_mask,
+            )
+        else:
+            self._static_args = (
+                self._U, self._V, self._shard_gid, self._item_pad_mask,
+            )
+        per_shard = int(self._V.nbytes) // plan.n_shards
+        if self._Vscale is not None:
+            per_shard += int(self._Vscale.nbytes) // plan.n_shards
+        self.resident_shard_bytes = [per_shard] * plan.n_shards
+
     def _compile(self, b: int):
         """Lower + compile the bucket-b program ahead of time."""
+        if self.sharding == "sharded":
+            return self._compile_sharded(b)
         k = self.k
         be = self.backend
 
@@ -199,6 +372,76 @@ class BucketedScorer:
                 return gather_score_topk(
                     U, V, u_idx, k, item_mask=item_pad_mask, backend=be
                 )
+
+        dummy_idx = jax.device_put(np.zeros(b, np.int32), self._repl)
+        compiled = (
+            jax.jit(fn)
+            .lower(*self._static_args, dummy_idx)
+            .compile()
+        )
+        self.compile_count += 1
+        self._annotate_cost(b, compiled)
+        return compiled
+
+    def _compile_sharded(self, b: int):
+        """AOT-compile the bucket-b fan-out → local top-k → merge program.
+
+        One program per rung, same ladder and warmup contract as the
+        replicated path.  Inside ``shard_map`` each device runs the
+        existing ``gather_score_topk`` over ONLY its local item block and
+        maps local winners to global ids; the shard-stacked
+        (S, B, local_k) leaderboards leave the shard region sharded, and
+        the transpose+merge outside forces the partitioner to emit one
+        small leaderboard all-gather (S·B·local_k·8 bytes) — never the
+        (B, n_items) score matrix.  ``merge_topk``'s (value desc, id asc)
+        order makes the result bit-identical to the replicated reference.
+        """
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        k = self.k
+        lk = self._local_k
+        be = self.backend
+        S = self.plan.n_shards
+        mesh = self._shard_ctx.mesh
+
+        if self.factor_dtype == "int8":
+
+            def local(U, Vl, u_scale, vs_l, gidl, maskl, u_idx):
+                vals, idx = gather_score_topk(
+                    U, Vl, u_idx, lk, item_mask=maskl,
+                    u_scale=u_scale, v_scale=vs_l, backend=be,
+                )
+                return vals[None], jnp.take(gidl, idx)[None]
+
+            in_specs = (
+                P(), P(DATA_AXIS, None), P(), P(DATA_AXIS, None),
+                P(DATA_AXIS), P(DATA_AXIS), P(),
+            )
+        else:
+
+            def local(U, Vl, gidl, maskl, u_idx):
+                vals, idx = gather_score_topk(
+                    U, Vl, u_idx, lk, item_mask=maskl, backend=be
+                )
+                return vals[None], jnp.take(gidl, idx)[None]
+
+            in_specs = (
+                P(), P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(),
+            )
+        out_specs = (
+            P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+        )
+
+        def fn(*args):
+            lv, lg = shard_map(
+                local, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+            )(*args)
+            # (S, B, lk) → (B, S·lk) candidate rows; the global reshape
+            # is what pulls the leaderboards across the mesh
+            cand_v = jnp.swapaxes(lv, 0, 1).reshape(b, S * lk)
+            cand_g = jnp.swapaxes(lg, 0, 1).reshape(b, S * lk)
+            return merge_topk(cand_v, cand_g, k)
 
         dummy_idx = jax.device_put(np.zeros(b, np.int32), self._repl)
         compiled = (
@@ -228,6 +471,7 @@ class BucketedScorer:
             self.devprof.set_cost(
                 b, a_flops, a_bytes, source="analytic-fused"
             )
+            self._cost_bytes[b] = a_bytes
             return
         flops = nbytes = None
         try:
@@ -241,11 +485,13 @@ class BucketedScorer:
             pass
         if flops and nbytes:
             self.devprof.set_cost(b, flops, nbytes, source="xla")
+            self._cost_bytes[b] = float(nbytes)
         else:
             a_flops, a_bytes = _devprof.score_cost(
                 b, self._n_items_pad, rank, dtype=self.factor_dtype
             )
             self.devprof.set_cost(b, a_flops, a_bytes, source="analytic")
+            self._cost_bytes[b] = a_bytes
 
     def score_topk(
         self, user_indices: np.ndarray, k: int
@@ -319,11 +565,17 @@ class BucketedScorer:
                 # wall, not enqueue time. (The readback two lines down
                 # would block here anyway; this only moves the wait.)
                 jax.block_until_ready((vals, idx))  # pio: ignore[hotpath-block-sync]
-                self.devprof.record(b, time.perf_counter() - t0)
+                wall = time.perf_counter() - t0
+                self.devprof.record(b, wall)
             with self._lock:
                 self.hits[b] += 1
                 self.queries += len(chunk)
                 self.padded_rows += b - len(chunk)
+                if self._shard_acct is not None:
+                    self._shard_acct.note(
+                        np.asarray(idx)[: len(chunk), :k], b, wall,
+                        self._cost_bytes.get(b, 0.0),
+                    )
             # padded tail rows are real top-k rows for user 0 — dropped here
             idx_parts.append(np.asarray(idx)[: len(chunk), :k])
             val_parts.append(np.asarray(vals)[: len(chunk), :k])
@@ -411,9 +663,18 @@ class BucketedScorer:
                     round(flops / nbytes, 3) if flops and nbytes else None
                 ),
             }
+            dev = self.devprof.snapshot()
+            sharding = None
+            if self._shard_acct is not None:
+                sharding = self._shard_acct.snapshot(
+                    (dev or {}).get("busy_fraction"),
+                    self.resident_shard_bytes,
+                )
             return {
                 "buckets": list(self.buckets),
                 "top_k": self.k,
+                "serving_backend": self.sharding,
+                "sharding": sharding,
                 "kernel": kernel,
                 "compile_count": self.compile_count,
                 "bucket_hits": {str(b): h for b, h in hits.items()},
@@ -426,5 +687,5 @@ class BucketedScorer:
                 if self.queries
                 else None,
                 "hotset": hotset if self.hot_size else None,
-                "devprof": self.devprof.snapshot(),
+                "devprof": dev,
             }

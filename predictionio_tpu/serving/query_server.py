@@ -489,6 +489,9 @@ class QueryServer:
         if self._batcher is not None:
             _bridges.bridge_batcher(reg, self._batcher.stats)
         _bridges.bridge_fastpath(reg, self._fastpath_stats)
+        # pio_shard_*: emits only while a ShardingPlan is live (the stats
+        # block is absent under replicated placement)
+        _bridges.bridge_sharding(reg, self._fastpath_stats)
         # live device utilization: the scorer's cost-annotated dispatch
         # accountant, labeled with the generation it serves (the scorer —
         # and its accountant — are rebuilt on every successful reload)
@@ -908,6 +911,15 @@ class QueryServer:
                 "generation": generation,
                 "fastpathWarm": warm,
             }
+            # sharded placement: surface backend + plan fingerprint so a
+            # rebalance is visible as a generation identity change to
+            # anything probing readiness (pio shards, the fleet router)
+            fps = self._fastpath_stats()
+            if fps and fps.get("serving_backend"):
+                body["servingBackend"] = fps["serving_backend"]
+                plan = (fps.get("sharding") or {}).get("plan") or {}
+                if plan.get("fingerprint"):
+                    body["shardingFingerprint"] = plan["fingerprint"]
             # every not-ready answer carries Retry-After, as the shed paths
             # do — docs/operations.md promises the header on all 503s
             retry = {"Retry-After": f"{self.retry_after_s():g}"}

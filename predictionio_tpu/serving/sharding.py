@@ -1,0 +1,453 @@
+"""Sharded serving: the ShardingPlan that scores catalogs bigger than one chip.
+
+The replicated fast path (``serving/fastpath.py``) keeps a full copy of
+the item-factor matrix on every device, capping the servable catalog at a
+single chip's HBM.  This module grows the second axis of scale: an
+explicit :class:`ShardingPlan` — shard count, item→shard assignment,
+per-shard capacity budget, and a content fingerprint — declared at model
+publish time and carried next to the factors through the sealed-blob
+checksum envelope (``core/persistence.py``).
+
+Execution shape (DrJAX's MapReduce-in-JAX playbook, PAPERS.md): item
+factors live PARTITIONED across the mesh, each query fans out so every
+shard runs the existing fused ``gather_score_topk`` kernel over only its
+local item block, and the only cross-device traffic is one small
+all-gather of per-shard ``(B, local_k)`` leaderboards plus an on-device
+two-key merge (:func:`predictionio_tpu.ops.topk.merge_topk`) — the
+``(B, n_items)`` score matrix never crosses a link.  Per-shard item lists
+are sorted ascending by global index, so shard-local ``lax.top_k`` tie
+order composes with the merge's ``(value desc, index asc)`` order into
+answers bit-identical to the single-device reference, cross-shard ties
+included.
+
+Placement is popularity-aware: serving traffic is Zipf-shaped, and the
+merge/readback load an item generates follows how often it WINS top-k
+slots, not how many bytes it occupies.  :func:`build_plan`'s
+``popularity`` strategy balances expected load (greedy LPT over item
+weights — live hot-set win counts, or factor norms as the publish-time
+proxy) under an item-count capacity cap, so both resident bytes and
+expected traffic stay level across shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import pickle
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+STRATEGIES = ("popularity", "round_robin", "contiguous")
+
+# payload bytes per merged leaderboard slot: f32 value + i32 global index
+MERGE_SLOT_BYTES = 8
+
+# global-index sentinel for padded leaderboard slots: larger than any real
+# item id, so an all-NEG_INF tie (fully masked row) still sorts real items
+# ahead of padding in the merge
+PAD_SENTINEL = np.int32(2**31 - 1)
+
+_PLAN_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPlan:
+    """Item→shard partition declared at publish time.
+
+    ``assignment[i]`` is the shard owning global item ``i``;
+    ``load_share`` is the expected per-shard traffic fraction under the
+    weights the plan was balanced with; ``capacity_budget_bytes`` records
+    the per-shard HBM budget the shard count was derived from (None when
+    the count was given explicitly).
+    """
+
+    n_shards: int
+    assignment: np.ndarray  # (n_items,) int32
+    strategy: str
+    load_share: np.ndarray  # (n_shards,) float64, sums to 1
+    capacity_budget_bytes: Optional[int] = None
+
+    @property
+    def n_items(self) -> int:
+        return int(self.assignment.shape[0])
+
+    def shard_items(self, shard: int) -> np.ndarray:
+        """Global item ids on ``shard``, ascending (the on-device order)."""
+        return np.flatnonzero(self.assignment == shard).astype(np.int32)
+
+    def shard_sizes(self) -> np.ndarray:
+        return np.bincount(self.assignment, minlength=self.n_shards)
+
+    @property
+    def fingerprint(self) -> str:
+        """Content hash over the partition itself — the plan's identity.
+
+        Published into the model manifest and surfaced through serving
+        stats/metrics, so a rebalance is visible as a generation change
+        even when the factors did not move.
+        """
+        h = hashlib.sha256()
+        h.update(f"{_PLAN_VERSION}:{self.n_shards}:{self.strategy}:".encode())
+        h.update(np.ascontiguousarray(self.assignment, np.int32).tobytes())
+        return h.hexdigest()[:16]
+
+    def validate(self, n_items: Optional[int] = None) -> None:
+        a = self.assignment
+        if a.ndim != 1 or (n_items is not None and a.shape[0] != n_items):
+            raise ValueError(
+                f"assignment shape {a.shape} does not cover {n_items} items"
+            )
+        if self.n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {self.n_shards}")
+        if a.size and (a.min() < 0 or a.max() >= self.n_shards):
+            raise ValueError("assignment references shards outside the plan")
+        sizes = self.shard_sizes()
+        if a.size and (sizes == 0).any():
+            empty = np.flatnonzero(sizes == 0).tolist()
+            raise ValueError(f"plan leaves shards empty: {empty}")
+
+    def to_payload(self) -> bytes:
+        return pickle.dumps(
+            {
+                "version": _PLAN_VERSION,
+                "n_shards": self.n_shards,
+                "strategy": self.strategy,
+                "assignment": np.ascontiguousarray(
+                    self.assignment, np.int32
+                ),
+                "load_share": np.ascontiguousarray(
+                    self.load_share, np.float64
+                ),
+                "capacity_budget_bytes": self.capacity_budget_bytes,
+            },
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "ShardingPlan":
+        d = pickle.loads(payload)
+        plan = cls(
+            n_shards=int(d["n_shards"]),
+            assignment=np.asarray(d["assignment"], np.int32),
+            strategy=str(d["strategy"]),
+            load_share=np.asarray(d["load_share"], np.float64),
+            capacity_budget_bytes=d.get("capacity_budget_bytes"),
+        )
+        plan.validate()
+        return plan
+
+    def describe(self) -> dict:
+        """JSON-friendly summary for the ``pio shards`` CLI and stats."""
+        sizes = self.shard_sizes()
+        return {
+            "n_shards": self.n_shards,
+            "n_items": self.n_items,
+            "strategy": self.strategy,
+            "fingerprint": self.fingerprint,
+            "capacity_budget_bytes": self.capacity_budget_bytes,
+            "items_per_shard": sizes.tolist(),
+            "load_share": [round(float(x), 6) for x in self.load_share],
+        }
+
+
+def shard_count_for_budget(
+    n_items: int, bytes_per_item: float, budget_bytes: int
+) -> int:
+    """Smallest shard count whose per-shard resident bytes fit ``budget``."""
+    if budget_bytes <= 0:
+        raise ValueError("per-shard HBM budget must be positive")
+    total = float(n_items) * float(bytes_per_item)
+    return max(1, int(np.ceil(total / float(budget_bytes))))
+
+
+def build_plan(
+    n_items: int,
+    n_shards: Optional[int] = None,
+    *,
+    weights: Optional[np.ndarray] = None,
+    strategy: str = "popularity",
+    capacity_budget_bytes: Optional[int] = None,
+    bytes_per_item: Optional[float] = None,
+) -> ShardingPlan:
+    """Build a plan by explicit shard count or per-shard byte budget.
+
+    ``weights`` are per-item expected-traffic weights (hot-set win
+    counts, Zipf pmf, factor norms — any non-negative signal); the
+    ``popularity`` strategy runs greedy LPT over them under an item-count
+    capacity cap of ``ceil(n_items / n_shards)`` so byte residency stays
+    balanced while expected load levels out.  ``round_robin`` and
+    ``contiguous`` ignore the weights for assignment but still record the
+    resulting per-shard load shares, so an imbalanced naive plan is
+    visible in its own manifest.
+    """
+    if strategy not in STRATEGIES:
+        raise ValueError(
+            f"strategy must be one of {STRATEGIES}, got {strategy!r}"
+        )
+    if n_items < 1:
+        raise ValueError("cannot shard an empty catalog")
+    if n_shards is None:
+        if capacity_budget_bytes is None or bytes_per_item is None:
+            raise ValueError(
+                "need n_shards, or capacity_budget_bytes + bytes_per_item"
+            )
+        n_shards = shard_count_for_budget(
+            n_items, bytes_per_item, capacity_budget_bytes
+        )
+    n_shards = int(n_shards)
+    if not 1 <= n_shards <= n_items:
+        raise ValueError(
+            f"n_shards={n_shards} outside [1, n_items={n_items}]"
+        )
+    if weights is None:
+        w = np.ones(n_items, np.float64)
+    else:
+        w = np.asarray(weights, np.float64).reshape(-1)
+        if w.shape[0] != n_items:
+            raise ValueError(
+                f"weights cover {w.shape[0]} items, catalog has {n_items}"
+            )
+        if (w < 0).any():
+            raise ValueError("weights must be non-negative")
+
+    assignment = np.empty(n_items, np.int32)
+    if strategy == "round_robin":
+        assignment[:] = np.arange(n_items, dtype=np.int32) % n_shards
+    elif strategy == "contiguous":
+        cap = int(np.ceil(n_items / n_shards))
+        assignment[:] = np.minimum(
+            np.arange(n_items, dtype=np.int64) // cap, n_shards - 1
+        ).astype(np.int32)
+    else:  # popularity: greedy LPT under an item-count capacity cap
+        cap = int(np.ceil(n_items / n_shards))
+        # heaviest first; ties by ascending id keep the build deterministic
+        order = np.lexsort((np.arange(n_items), -w))
+        load = np.zeros(n_shards, np.float64)
+        counts = np.zeros(n_shards, np.int64)
+        for i in order:
+            open_shards = np.flatnonzero(counts < cap)
+            s = open_shards[np.argmin(load[open_shards])]
+            assignment[i] = s
+            load[s] += w[i]
+            counts[s] += 1
+
+    per_shard = np.zeros(n_shards, np.float64)
+    np.add.at(per_shard, assignment, w)
+    total = per_shard.sum()
+    load_share = (
+        per_shard / total if total > 0
+        else np.full(n_shards, 1.0 / n_shards)
+    )
+    plan = ShardingPlan(
+        n_shards=n_shards,
+        assignment=assignment,
+        strategy=strategy,
+        load_share=load_share,
+        capacity_budget_bytes=capacity_budget_bytes,
+    )
+    plan.validate(n_items)
+    return plan
+
+
+def plan_from_env(
+    n_items: int,
+    weights: Optional[np.ndarray] = None,
+    bytes_per_item: Optional[float] = None,
+) -> Optional[ShardingPlan]:
+    """Publish-time plan declaration from the PIO_SHARD_* knobs.
+
+    Returns None when neither ``PIO_SHARD_COUNT`` nor
+    ``PIO_SHARD_HBM_BUDGET`` is set — the model publishes unsharded and
+    every existing caller is untouched.
+    """
+    import os
+
+    count = os.environ.get("PIO_SHARD_COUNT", "")
+    budget = os.environ.get("PIO_SHARD_HBM_BUDGET", "")
+    strategy = (
+        os.environ.get("PIO_SHARD_STRATEGY") or "popularity"
+    ).strip().lower()
+    if not count.strip() and not budget.strip():
+        return None
+    return build_plan(
+        n_items,
+        n_shards=int(count) if count.strip() else None,
+        weights=weights,
+        strategy=strategy,
+        capacity_budget_bytes=int(budget) if budget.strip() else None,
+        bytes_per_item=bytes_per_item,
+    )
+
+
+def save_plan(path: str, plan: ShardingPlan) -> None:
+    """Seal the plan into ``path`` through the checksum envelope
+    (atomic tmp+rename — the same publish guarantee as ``quant.blob``)."""
+    from predictionio_tpu.core import persistence as _persistence
+
+    _persistence.seal_blob_file(path, plan.to_payload())
+
+
+def load_plan(path: str) -> ShardingPlan:
+    """Open a sealed plan; raises ``ModelIntegrityError`` on a torn blob,
+    ``OSError`` when missing — callers degrade to replicated serving."""
+    from predictionio_tpu.core import persistence as _persistence
+
+    return ShardingPlan.from_payload(_persistence.open_blob_file(path))
+
+
+# ---------------------------------------------------------------------------
+# Device layout: plan → permuted/padded arrays the executor places
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardLayout:
+    """Host-side arrays realizing a plan as equal-size device blocks.
+
+    ``perm`` is ``(n_shards, cap_pad)`` of global item ids (−1 on padded
+    slots); every shard's real slots are ascending by global id, which is
+    what makes shard-local ``lax.top_k`` tie order compose with the
+    global merge.  ``cap_pad`` is the common padded per-shard capacity
+    (kernel block aligned), so the concatenated factor block is one
+    ``(n_shards·cap_pad, rank)`` array sharded P("data", None).
+    """
+
+    n_shards: int
+    cap_pad: int
+    perm: np.ndarray  # (n_shards, cap_pad) int64, -1 = padding
+
+    @property
+    def gid(self) -> np.ndarray:
+        """Flat (n_shards·cap_pad,) global ids; PAD_SENTINEL on padding."""
+        g = np.where(self.perm >= 0, self.perm, PAD_SENTINEL)
+        return g.reshape(-1).astype(np.int32)
+
+    @property
+    def pad_mask(self) -> np.ndarray:
+        """Flat bool mask, True on padded (never-winning) slots."""
+        return (self.perm < 0).reshape(-1)
+
+    def take_rows(self, rows: np.ndarray, fill=0) -> np.ndarray:
+        """Gather ``rows[global_id]`` into shard layout, ``fill`` on pads."""
+        rows = np.asarray(rows)
+        flat = self.perm.reshape(-1)
+        out = rows[np.clip(flat, 0, None)].copy()
+        out[flat < 0] = fill
+        return out
+
+
+def build_layout(plan: ShardingPlan, pad_to) -> ShardLayout:
+    """Realize ``plan`` as equal padded shard blocks.
+
+    ``pad_to`` maps the largest shard's item count to the common padded
+    capacity — the fastpath passes the fused kernel's block padding or
+    the reference path's multiple-of-8 rule, matching what the replicated
+    scorer does to its single block.
+    """
+    sizes = plan.shard_sizes()
+    cap_pad = int(pad_to(int(sizes.max())))
+    perm = np.full((plan.n_shards, cap_pad), -1, np.int64)
+    for s in range(plan.n_shards):
+        ids = plan.shard_items(s)  # ascending — the tie-order invariant
+        perm[s, : len(ids)] = ids
+    return ShardLayout(
+        n_shards=plan.n_shards, cap_pad=cap_pad, perm=perm
+    )
+
+
+# ---------------------------------------------------------------------------
+# Runtime accounting: per-shard load realized by live traffic
+# ---------------------------------------------------------------------------
+
+
+class ShardAccounting:
+    """Per-shard counters fed by the fastpath dispatch loop.
+
+    All device work in one SPMD dispatch is simultaneous, so a shard's
+    *busy seconds* are not separately observable; what IS measured per
+    shard is its result load — how many top-k slots its items win, which
+    drives the merge/readback traffic and downstream hydration a shard
+    generates.  ``snapshot`` attributes the measured whole-mesh busy
+    fraction across shards by that realized win share (documented in
+    docs/operations.md as an attributed quantity; the max/min balance the
+    bench gates on depends only on the shares).
+
+    Counters are guarded by an internal lock: ``note`` runs on request
+    threads while ``snapshot`` runs on the stats/metrics scrape thread.
+    """
+
+    def __init__(self, plan: ShardingPlan, local_k: int):
+        import threading
+
+        self.plan = plan
+        self._assign = plan.assignment
+        self.local_k = int(local_k)
+        self._lock = threading.Lock()
+        n = plan.n_shards
+        self.queries_routed = np.zeros(n, np.int64)  # fan-out: rows/shard
+        self.result_wins = np.zeros(n, np.int64)  # top-k slots won
+        self.merge_bytes = 0.0  # analytic all-gather payload
+        self.merge_seconds = 0.0  # attributed share of device wall
+
+    def note(
+        self, winner_ids: np.ndarray, batch_rows: int,
+        device_seconds: float, dispatch_bytes: float,
+    ) -> None:
+        """Charge one dispatch: winners (B, k) global ids, real rows B."""
+        ids = np.asarray(winner_ids).reshape(-1)
+        ids = ids[(ids >= 0) & (ids < self._assign.shape[0])]
+        # one all-gather of S leaderboards of (B, local_k) slots each
+        mb = (
+            float(self.plan.n_shards)
+            * float(batch_rows)
+            * float(self.local_k)
+            * MERGE_SLOT_BYTES
+        )
+        with self._lock:
+            if len(ids):
+                np.add.at(
+                    self.result_wins, self._assign[ids.astype(np.int64)], 1
+                )
+            self.queries_routed += int(batch_rows)
+            self.merge_bytes += mb
+            if dispatch_bytes > 0:
+                self.merge_seconds += float(device_seconds) * min(
+                    1.0, mb / float(dispatch_bytes)
+                )
+
+    def snapshot(
+        self, busy_fraction: Optional[float],
+        resident_bytes_per_shard: list,
+    ) -> dict:
+        n = self.plan.n_shards
+        with self._lock:
+            wins = self.result_wins.astype(np.float64)
+            routed = self.queries_routed.tolist()
+            raw_wins = self.result_wins.tolist()
+            merge_bytes = self.merge_bytes
+            merge_seconds = self.merge_seconds
+        total = wins.sum()
+        if total > 0:
+            share = wins / total
+        else:
+            # no traffic yet: fall back to the plan's expected shares
+            share = np.asarray(self.plan.load_share, np.float64)
+        busy = (
+            [round(float(busy_fraction) * n * float(s), 6) for s in share]
+            if busy_fraction is not None else None
+        )
+        return {
+            "plan": self.plan.describe(),
+            "local_k": self.local_k,
+            "queries_routed": routed,
+            "result_wins": raw_wins,
+            "result_share": [round(float(s), 6) for s in share],
+            "busy_fraction": busy,
+            "resident_bytes": resident_bytes_per_shard,
+            "merge_bytes": merge_bytes,
+            "merge_seconds": round(merge_seconds, 6),
+        }

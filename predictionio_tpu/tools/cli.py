@@ -723,6 +723,99 @@ def cmd_instances(args) -> int:
     return 0
 
 
+def cmd_shards(args) -> int:
+    """Inspect or rebuild a published model's ShardingPlan.
+
+    ``show`` reads the sealed plan.blob beside a checkpoint-persisted
+    model's factors; ``rebuild`` re-balances the item→shard assignment
+    offline and republishes it through the same atomic sealed-blob
+    machinery (tmp+fsync+rename), so a live server picks the new plan up
+    on its next ``POST /reload`` — or falls back to its last-known-good
+    generation if the rewrite was torn mid-flight.
+    """
+    import os
+    import pickle
+
+    from predictionio_tpu.serving import sharding as _sharding
+    from predictionio_tpu.utils.fs import pio_base_dir
+
+    base = os.path.join(pio_base_dir(), "persistent_models")
+
+    def plan_path(iid: str) -> str:
+        return os.path.join(base, iid, "plan.blob")
+
+    if args.shards_command == "show":
+        if args.instance:
+            instances = [args.instance]
+        elif os.path.isdir(base):
+            instances = sorted(os.listdir(base))
+        else:
+            instances = []
+        rows = []
+        for iid in instances:
+            p = plan_path(iid)
+            if not os.path.exists(p):
+                if args.instance:
+                    print(f"[INFO] {iid}: no sharding plan (replicated)")
+                continue
+            try:
+                plan = _sharding.load_plan(p)
+                rows.append({"instance": iid, **plan.describe()})
+            except Exception as e:
+                rows.append({"instance": iid, "error": str(e)})
+        print(json.dumps(rows, indent=2))
+        return 0
+
+    # rebuild
+    iid = args.instance
+    d = os.path.join(base, iid)
+    maps_path = os.path.join(d, "maps.pkl")
+    if not os.path.exists(maps_path):
+        return _die(f"no checkpoint-persisted model at {d}")
+    from predictionio_tpu.core.checkpoint import restore_pytree
+
+    factors = restore_pytree(os.path.join(d, "factors"))
+    V = factors["item_factors"]
+    n_items = int(V.shape[0])
+    bytes_per_item = float(V.shape[1]) * 4.0
+    weights = None
+    if args.weights == "norm":
+        import numpy as np
+
+        weights = np.linalg.norm(np.asarray(V, np.float32), axis=1)
+    try:
+        plan = _sharding.build_plan(
+            n_items,
+            n_shards=args.shards,
+            weights=weights,
+            strategy=args.strategy,
+            capacity_budget_bytes=args.budget,
+            bytes_per_item=bytes_per_item,
+        )
+    except ValueError as e:
+        return _die(f"cannot build plan: {e}")
+    _sharding.save_plan(plan_path(iid), plan)
+    with open(maps_path, "rb") as f:
+        meta = pickle.load(f)
+    meta["sharding"] = {
+        "n_shards": plan.n_shards,
+        "strategy": plan.strategy,
+        "fingerprint": plan.fingerprint,
+    }
+    tmp = f"{maps_path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, maps_path)
+    print(json.dumps({"instance": iid, **plan.describe()}, indent=2))
+    print(
+        "[INFO] Plan resealed. POST /reload on the serving deployment to "
+        "pick it up (the LKG machinery guards the swap)."
+    )
+    return 0
+
+
 def cmd_loadtest(args) -> int:
     from predictionio_tpu.tools.loadtest import run_ingest_loadtest, run_loadtest
 
@@ -1114,6 +1207,34 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--timeout", type=float, default=600.0,
                    help="seconds to wait for the roll to finish")
     x.set_defaults(func=cmd_fleet)
+
+    sp = sub.add_parser(
+        "shards", help="inspect or rebuild a published model's sharded-"
+        "serving plan",
+    )
+    shards_sub = sp.add_subparsers(dest="shards_command", required=True)
+    x = shards_sub.add_parser(
+        "show", help="print the sealed ShardingPlan of one (or every) "
+        "checkpoint-persisted model instance",
+    )
+    x.add_argument("--instance", default=None)
+    x.set_defaults(func=cmd_shards)
+    x = shards_sub.add_parser(
+        "rebuild", help="re-balance the item→shard assignment offline and "
+        "reseal plan.blob; a live server adopts it on POST /reload",
+    )
+    x.add_argument("--instance", required=True)
+    x.add_argument("--shards", type=int, default=None,
+                   help="explicit shard count")
+    x.add_argument("--budget", type=int, default=None,
+                   help="per-shard HBM byte budget (derives the count)")
+    x.add_argument("--strategy", default="popularity",
+                   choices=["popularity", "round_robin", "contiguous"])
+    x.add_argument("--weights", default="norm",
+                   choices=["norm", "uniform"],
+                   help="popularity weights: item-factor L2 norms (the "
+                   "traffic proxy) or uniform")
+    x.set_defaults(func=cmd_shards)
 
     sp = sub.add_parser("undeploy")
     sp.add_argument("--ip", default="127.0.0.1")

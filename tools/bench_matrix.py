@@ -33,7 +33,8 @@ OUT = os.path.join(REPO, "BENCH_TPU_MANUAL.json")
 _PIN = {"BENCH_REBALANCE": "1", "BENCH_DTYPE": "f32"}
 _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
          "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0",
-         "BENCH_KERNEL": "0", "BENCH_FLEET": "0", "BENCH_ELASTIC": "0"}
+         "BENCH_KERNEL": "0", "BENCH_FLEET": "0", "BENCH_ELASTIC": "0",
+         "BENCH_SHARDED": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -241,6 +242,32 @@ def main() -> int:
         "preemptions": ela.get("preemptions"),
         "gate_pass": ela.get("gate_pass"),
     }
+    # sharded-serving gate (ISSUE 12): a catalog sized past one device's
+    # (simulated) HBM budget, served partitioned under Zipf load — sharded
+    # answers must be bit-identical to the replicated reference, per-shard
+    # utilization must be non-null, and the popularity-aware plan's
+    # max/min attributed busy balance must stay <= 1.5 (the naive
+    # round-robin balance rides along uncapped for comparison)
+    shd = (primary.get("multichip") or {}).get("sharded_serving") or {}
+    shd_plans = shd.get("plans") or {}
+    artifact["multichip"] = {
+        "sharded_serving": {
+            "catalog_bytes": shd.get("catalog_bytes"),
+            "per_device_budget_bytes": shd.get("per_device_budget_bytes"),
+            "n_shards": shd.get("n_shards"),
+            "popularity_busy_balance": (
+                shd_plans.get("popularity") or {}
+            ).get("busy_balance"),
+            "round_robin_busy_balance": (
+                shd_plans.get("round_robin") or {}
+            ).get("busy_balance"),
+            "exact_match": all(
+                (p or {}).get("exact_match") is True
+                for p in shd_plans.values()
+            ) if shd_plans else None,
+            "gate_pass": shd.get("gate_pass"),
+        },
+    }
     # static-analysis gate: perf numbers from a repo carrying hot-path or
     # race hazards are not publishable — `pio analyze` must report zero
     # errors for the matrix to count
@@ -278,6 +305,7 @@ def main() -> int:
         "serving_utilization": artifact["serving_utilization"],
         "kernel": artifact["kernel"],
         "fleet": artifact["fleet"],
+        "multichip": artifact["multichip"],
         "analysis": artifact["analysis"],
     }))
     return 0 if all_tpu else 1
