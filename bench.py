@@ -1068,6 +1068,148 @@ def _kernel_bench(platform: str, n_items: int, rank: int) -> dict:
     return out
 
 
+def _train_kernel_bench(
+    ctx, platform: str, n_users: int, n_items: int, n_ratings: int,
+    rank: int,
+) -> dict:
+    """Training-kernel block: fused Pallas vs XLA reference, per COMPUTE
+    dtype (``PIO_ALS_COMPUTE_DTYPE``).
+
+    Three kinds of evidence per dtype (f32/bf16/int8):
+
+    * **Analytic roofline** at the artifact's training shape — the
+      reference backend priced with the gather term XLA actually pays
+      (~512 B sector per factor row, ``als_train_cost_amplified``)
+      against the fused kernel's one-sequential-V-read model
+      (``fused_train_cost``), plus the expected ms/iteration each
+      implies (max of compute time and HBM time at TPU peaks).  The
+      matrix gate holds fused intensity STRICTLY above the reference
+      for every dtype and the int8 one-pass V read to ≤ ½ the f32
+      bytes.
+    * **Equivalence** — a small train on the live mesh, fused (the real
+      kernel body, interpret off-TPU) vs reference, per dtype; the f32
+      factors must be BIT-equal, bf16/int8 within documented tolerance.
+    * **Measured rating-updates/s**, TPU only — on CPU the fused path
+      would bench the Pallas *interpreter*, so CPU artifacts carry
+      ``measured: null``.
+    """
+    from predictionio_tpu.models.als import ALSConfig, train_als
+    from predictionio_tpu.obs.devprof import (
+        PEAKS,
+        als_train_cost_amplified,
+        fused_train_cost,
+        fused_train_vread_bytes,
+    )
+
+    peak = PEAKS["tpu"]
+    on_tpu = platform == "tpu"
+    out: dict = {
+        "shape": {
+            "users": n_users, "items": n_items, "ratings": n_ratings,
+            "rank": rank,
+        },
+        "measured_backend": platform if on_tpu else None,
+        "dtypes": {},
+    }
+
+    def roofline(flops: float, nbytes: float) -> dict:
+        intensity = flops / nbytes
+        attainable = min(peak["flops"], intensity * peak["hbm_gbps"])
+        return {
+            "intensity_flops_per_byte": round(intensity, 3),
+            "roofline_mfu": round(attainable / peak["flops"], 4),
+            "expected_ms_per_iter": round(
+                max(flops / peak["flops"], nbytes / peak["hbm_gbps"]) * 1e3,
+                3,
+            ),
+        }
+
+    # equivalence workload: small enough to train on any mesh in seconds,
+    # ragged enough (Zipf) to hit multi-bucket dense shapes
+    eq_inter = _make_interactions(
+        "zipf", min(n_users, 384), min(n_items, 256), min(n_ratings, 6000)
+    )
+    f32_vread = fused_train_vread_bytes(n_users, n_items, rank, "f32")
+    for cd in ("f32", "bf16", "int8"):
+        ref = roofline(
+            *als_train_cost_amplified(n_ratings, n_users, n_items, rank)
+        )
+        fused = roofline(
+            *fused_train_cost(n_ratings, n_users, n_items, rank, cd)
+        )
+        vread = fused_train_vread_bytes(n_users, n_items, rank, cd)
+        factors = {}
+        for backend in ("reference", "fused"):
+            m = train_als(ctx, eq_inter, ALSConfig(
+                rank=rank, iterations=2, seed=7, compute_dtype=cd,
+                train_kernel=backend,
+            ))
+            factors[backend] = (m.user_factors, m.item_factors)
+        bit_equal = bool(
+            np.array_equal(factors["fused"][0], factors["reference"][0])
+            and np.array_equal(factors["fused"][1], factors["reference"][1])
+        )
+        cell = {
+            "reference": ref,
+            "fused": fused,
+            "intensity_gain": round(
+                fused["intensity_flops_per_byte"]
+                / ref["intensity_flops_per_byte"], 2
+            ),
+            "vread_bytes": vread,
+            "vread_vs_f32": round(vread / f32_vread, 4),
+            "factors_bit_equal": bit_equal,
+        }
+        if on_tpu:
+            # measured A/B on the full bench workload, rating-updates/s
+            # (each rating is touched twice per iteration — both sides)
+            iters = int(os.environ.get("BENCH_TRAIN_KERNEL_ITERS", 3))
+            inter = _make_interactions(
+                "uniform", n_users, n_items, n_ratings
+            )
+            measured = {}
+            for backend in ("reference", "fused"):
+                cfg = ALSConfig(
+                    rank=rank, iterations=iters, seed=7,
+                    compute_dtype=cd, train_kernel=backend,
+                )
+                train_als(ctx, inter, ALSConfig(  # compile + warm
+                    rank=rank, iterations=1, seed=7, compute_dtype=cd,
+                    train_kernel=backend,
+                ))
+                t0 = time.perf_counter()
+                train_als(ctx, inter, cfg)
+                dt = time.perf_counter() - t0
+                measured[backend] = round(n_ratings * 2 * iters / dt, 1)
+            cell["measured_updates_per_sec"] = measured
+            cell["measured_gain"] = round(
+                measured["fused"] / measured["reference"], 2
+            )
+        out["dtypes"][cd] = cell
+
+    # matrix gates: fused analytic intensity STRICTLY above the
+    # sector-amplified reference for EVERY compute dtype, the int8
+    # one-pass V read ≤ ½ the f32 bytes, and f32 factors bit-equal
+    # across backends (bf16/int8 ride the documented-tolerance suite)
+    gate = all(
+        c["fused"]["intensity_flops_per_byte"]
+        > c["reference"]["intensity_flops_per_byte"]
+        for c in out["dtypes"].values()
+    )
+    gate = gate and out["dtypes"]["int8"]["vread_vs_f32"] <= 0.5
+    gate = gate and out["dtypes"]["f32"]["factors_bit_equal"]
+    if on_tpu:
+        gate = gate and all(
+            c.get("measured_gain", 0.0) >= 1.0
+            for c in out["dtypes"].values()
+        )
+    out["intensity_gain_f32"] = out["dtypes"]["f32"]["intensity_gain"]
+    out["int8_vread_vs_f32"] = out["dtypes"]["int8"]["vread_vs_f32"]
+    out["factors_bit_equal_f32"] = out["dtypes"]["f32"]["factors_bit_equal"]
+    out["gate_pass"] = bool(gate)
+    return out
+
+
 _FLEET_CHILD = """
 import os
 from predictionio_tpu.data import store as store_mod
@@ -1871,6 +2013,16 @@ def main() -> None:
             print(f"WARNING: kernel bench failed: {e}", file=sys.stderr)
             kernel = {"error": str(e)}
         print(f"INFO: kernel: {kernel}", file=sys.stderr)
+    train_kernel = None
+    if os.environ.get("BENCH_TRAIN_KERNEL", "1") != "0":
+        try:
+            train_kernel = _train_kernel_bench(
+                ctx, platform, n_users, n_items, n_ratings, rank,
+            )
+        except Exception as e:  # the train A/B must never kill the artifact
+            print(f"WARNING: train-kernel bench failed: {e}", file=sys.stderr)
+            train_kernel = {"error": str(e)}
+        print(f"INFO: train_kernel: {train_kernel}", file=sys.stderr)
     fleet = None
     if os.environ.get("BENCH_FLEET", "1") != "0":
         try:
@@ -1934,6 +2086,8 @@ def main() -> None:
         record["observability"] = observability
     if kernel is not None:
         record["kernel"] = kernel
+    if train_kernel is not None:
+        record["train_kernel"] = train_kernel
     if fleet is not None:
         record["fleet"] = fleet
     if elastic is not None:

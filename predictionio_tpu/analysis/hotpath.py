@@ -153,6 +153,19 @@ def traced_functions(mod: Module) -> dict[ast.FunctionDef, set[str]]:
             p = parents.get(p)
         return id(p)
 
+    # local `kern = partial(_kern, k=...)` bindings, chased when the name
+    # handed to pallas_call is an assignment rather than a FunctionDef
+    # (ops/train_kernel.py idiom: specialise once, launch below)
+    partial_assigns: dict[tuple[int, str], ast.Call] = {}
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+        ):
+            partial_assigns[(scope_of(node), node.targets[0].id)] = \
+                node.value
     for node in ast.walk(mod.tree):
         if isinstance(node, ast.FunctionDef):
             by_scope_name[(scope_of(node), node.name)] = node
@@ -194,6 +207,15 @@ def traced_functions(mod: Module) -> dict[ast.FunctionDef, set[str]]:
         # sits inside a wrapper function: fall back to module scope
         fn = by_scope_name.get((scope_of(node), target)) or \
             by_scope_name.get((id(mod.tree), target))
+        if fn is None and is_pallas:
+            # the name is a local `kern = partial(_kern, ...)` binding,
+            # not a FunctionDef: chase it to the underlying kernel
+            bound = partial_assigns.get((scope_of(node), target))
+            if bound is not None:
+                target, extra_static = _partial_kernel(bound)
+                if target is not None:
+                    fn = by_scope_name.get((scope_of(node), target)) or \
+                        by_scope_name.get((id(mod.tree), target))
         if fn is not None and fn not in out:
             if is_pallas:
                 # Pallas hands refs positionally; a kernel's keyword-only

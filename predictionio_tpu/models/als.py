@@ -68,9 +68,14 @@ class ALSConfig:
     # training resumes from the latest step found there
     checkpoint_dir: Optional[str] = None
     checkpoint_interval: int = 5
-    # "bf16": store/gather the opposite factor matrix in bfloat16 (halves
-    # the gather + all-gather HBM traffic); all arithmetic stays f32.
-    compute_dtype: str = "f32"
+    # Compute dtype for the GATHERED opposite factors ("f32" | "bf16" |
+    # "int8"): bf16 stores/gathers the opposite matrix in bfloat16 (halves
+    # the gather + all-gather HBM traffic), int8 quantizes it per half-step
+    # with per-row scales (quarter the one-pass V read on the fused
+    # kernel); every contraction accumulates f32.  None → the
+    # PIO_ALS_COMPUTE_DTYPE env knob (default "f32"), resolved at
+    # construction time like `solver`.
+    compute_dtype: Optional[str] = None
     # Relabel entities by rating count (round-robin hot entities across
     # shards) before range-blocking, so Zipf-skewed catalogs don't pad
     # every shard to the hottest block's length. Pure host-side; factors
@@ -89,13 +94,33 @@ class ALSConfig:
     # at CONSTRUCTION time (None → env), not import time, so an in-process
     # sweep toggling the env var between configs takes effect.
     solver: Optional[str] = None
+    # Training-kernel backend ("fused" | "reference" | "auto"): the
+    # dispatch seam for ops/train_kernel.py, mirroring PIO_SCORE_KERNEL.
+    # "auto" takes the Pallas path only on real TPU; PIO_NATIVE=0 forces
+    # "reference" at resolution time.  None → the PIO_TRAIN_KERNEL env
+    # knob (default "auto"), resolved at construction time.
+    train_kernel: Optional[str] = None
 
     def __post_init__(self):
         if self.solver is None:
             self.solver = os.environ.get("PIO_ALS_SOLVER", "dense")
-        if self.compute_dtype not in ("f32", "bf16"):
+        if self.compute_dtype is None:
+            self.compute_dtype = os.environ.get(
+                "PIO_ALS_COMPUTE_DTYPE", "f32"
+            )
+        if self.train_kernel is None:
+            self.train_kernel = os.environ.get("PIO_TRAIN_KERNEL", "auto")
+        if self.compute_dtype not in ("f32", "bf16", "int8"):
             raise ValueError(
-                f"compute_dtype must be 'f32' or 'bf16', got {self.compute_dtype!r}"
+                "compute_dtype must be 'f32', 'bf16', or 'int8', "
+                f"got {self.compute_dtype!r}"
+            )
+        from predictionio_tpu.ops import train_kernel as _train_kernel
+
+        if self.train_kernel not in _train_kernel.BACKENDS:
+            raise ValueError(
+                f"train_kernel must be one of {_train_kernel.BACKENDS}, "
+                f"got {self.train_kernel!r}"
             )
         if self.solver not in ("dense", "segment"):
             raise ValueError(
@@ -426,7 +451,7 @@ _CHUNK = int(os.environ.get("PIO_ALS_CHUNK", 65536))
 
 def _half_step_local(
     local, other, rating, mask, opp_full, gram, per_shard, rank, reg, implicit,
-    alpha, bf16=False,
+    alpha, compute_dtype="f32", backend="reference", interpret=None,
 ):
     """Runs per shard: normal equations + batched Cholesky for one block.
 
@@ -434,19 +459,39 @@ def _half_step_local(
     gram: VᵀV (k,k) for implicit mode, zeros otherwise.
     Accumulates A/b over rating chunks with lax.scan — peak memory is
     O(chunk·k² + per_shard·k²) instead of O(L·k²).
-    With bf16, the opposite factors are STORED and gathered in bfloat16
-    (half the HBM traffic); all arithmetic runs in f32.
+    ``compute_dtype`` narrows the stored/gathered opposite factors (bf16
+    downcast / per-row int8); all arithmetic runs in f32 after the gather.
+    ``backend="fused"`` routes the per-chunk gather through the Pallas
+    gather kernel (``ops/train_kernel.py:fused_gather_rows``) — the rows
+    fetch against a VMEM-resident V instead of paying XLA's per-row
+    sector read; the dequantized values are identical, so the rest of the
+    chunk body (and the trained factors) match bit-for-bit.
     """
+    from predictionio_tpu.ops import train_kernel as _train_kernel
+    from predictionio_tpu.ops.quantize import quantize_factors_jax
+
     L = local.shape[0]
     chunk = min(L, _CHUNK)
     n_chunks = L // chunk
-    if bf16:
-        opp_full = opp_full.astype(jnp.bfloat16)
+    opp_q, opp_scale = quantize_factors_jax(opp_full, compute_dtype)
+    if backend != "fused":
+        # reference dequantizes in XLA before the gather — the same values
+        # the fused kernel reconstructs in VMEM after it (per-row scale:
+        # gather and dequantize commute exactly)
+        opp_full = (
+            opp_q if opp_scale is None
+            else opp_q.astype(jnp.float32) * opp_scale
+        )
 
     def body(carry, xs):
         A, b, cnt = carry
         lo, ot, rt, w = xs
-        vs = opp_full[ot].astype(jnp.float32)  # (chunk, k) gather
+        if backend == "fused":
+            vs = _train_kernel.fused_gather_rows(
+                opp_q, ot, opp_scale, interpret=interpret
+            )  # (chunk, k) f32, gathered against VMEM
+        else:
+            vs = opp_full[ot].astype(jnp.float32)  # (chunk, k) gather
         if implicit:
             # A_u += Σ α·r · v vᵀ ;  b_u += Σ (1+α·r) · v   (p=1, c=1+αr)
             cw = alpha * rt * w
@@ -492,25 +537,49 @@ def _solve_normal_equations(A, b, cnt, gram, rank, reg, implicit):
 
 
 def _dense_half_step_local(
-    *args, n_buckets, rank, reg, implicit, alpha, bf16=False
+    *args, n_buckets, rank, reg, implicit, alpha, compute_dtype="f32",
+    backend="reference", interpret=None,
 ):
     """Scatter-free half-step: per degree bucket, one gather + batched
     einsum accumulates the normal equations — contraction rides the MXU,
     padding slots multiply by zero, and because bucket rows ARE the local
     entity order the per-bucket results simply concatenate (no scatter).
-    With bf16, factors gather and multiply in bfloat16 while the einsum
-    accumulates f32 (``preferred_element_type``), the MXU-native mode.
+    ``compute_dtype`` narrows the gathered side: bf16 factors gather and
+    multiply in bfloat16 while the einsum accumulates f32
+    (``preferred_element_type``), the MXU-native mode; int8 gathers the
+    quantized rows + per-row scales and dequantizes before the multiply.
+    ``backend="fused"`` replaces the per-bucket gather + einsum with ONE
+    ``pallas_call`` (``ops/train_kernel.py``): the opposite factors sit
+    VMEM-resident, the gather runs against VMEM (no sector
+    amplification), and the contraction is the identical batched
+    dot_general — the reference path below IS the kernel's math, operand
+    order and all, so the two backends solve bit-identical factors.
     """
+    from predictionio_tpu.ops import train_kernel as _train_kernel
+    from predictionio_tpu.ops.quantize import quantize_factors_jax
+
     bufs = args[: 3 * n_buckets]
     opp_full, gram = args[3 * n_buckets], args[3 * n_buckets + 1]
-    opp = opp_full.astype(jnp.bfloat16) if bf16 else opp_full
+    opp_q, opp_scale = quantize_factors_jax(opp_full, compute_dtype)
     f32 = jnp.float32
+    opp = (
+        opp_q if opp_scale is None else opp_q.astype(f32) * opp_scale
+    )  # reference compute copy (f32 or bf16; int8 dequantized in XLA)
     As, bs, cnts = [], [], []
     for i in range(n_buckets):
         # shard_map blocks keep the leading mesh dim: (1, n_b, D_b) → [0]
         idx = bufs[3 * i][0]
         rat = bufs[3 * i + 1][0]
         msk = bufs[3 * i + 2][0]
+        if backend == "fused":
+            A, bv, cnt = _train_kernel.fused_train_normal_eq(
+                idx, rat, msk, opp_q, opp_scale,
+                implicit=implicit, alpha=alpha, interpret=interpret,
+            )
+            As.append(A)
+            bs.append(bv)
+            cnts.append(cnt)
+            continue
         Vg = opp[idx]  # (n_b, D_b, k) gather in compute dtype
         w = msk.astype(Vg.dtype)
         if implicit:
@@ -542,11 +611,51 @@ def _dense_half_step_local(
     return _solve_normal_equations(A, b, cnt, gram, rank, reg, implicit)
 
 
+def _resolve_side_backend(cfg: ALSConfig, n_opp: int) -> str:
+    """The per-side training-kernel dispatch: the configured/env backend,
+    demoted to ``reference`` when the opposite factor matrix would blow
+    the VMEM residency budget (the fused kernel's one hard precondition —
+    ``docs/perf_roofline.md`` derives why resident-V is the whole win).
+    """
+    from predictionio_tpu.ops import train_kernel as _train_kernel
+
+    backend = _train_kernel.resolve_backend(getattr(cfg, "train_kernel", None))
+    if backend == "fused" and not _train_kernel.fits_vmem(
+        n_opp, cfg.rank, cfg.compute_dtype
+    ):
+        logger.warning(
+            "fused train kernel: opposite factors (%d × %d, %s) exceed the "
+            "VMEM residency budget; this side falls back to the reference "
+            "path", n_opp, cfg.rank, cfg.compute_dtype,
+        )
+        return "reference"
+    return backend
+
+
+def _record_train_kernel_stats(
+    cfg: ALSConfig, backend: str, n_users_pad: int, n_items_pad: int
+) -> None:
+    """Publish the resolved dispatch to the train-kernel stats the
+    /metrics bridge exports (``pio_train_kernel_*``)."""
+    from predictionio_tpu.ops import train_kernel as _train_kernel
+
+    _train_kernel.record_stats(
+        backend=backend,
+        compute_dtype=cfg.compute_dtype,
+        resident_bytes=_train_kernel.resident_bytes(
+            max(n_users_pad, n_items_pad), cfg.rank, cfg.compute_dtype
+        ),
+    )
+
+
 def _make_dense_step(mesh, ub: _DenseBlocks, ib: _DenseBlocks, cfg: ALSConfig):
     """Build the jitted full ALS iteration over the mesh (dense solver)."""
     rank, reg, alpha, implicit = cfg.rank, cfg.reg, cfg.alpha, cfg.implicit
+    n_shards = mesh.shape[DATA_AXIS]
+    n_users_pad = ub.per_shard * n_shards
+    n_items_pad = ib.per_shard * n_shards
 
-    def one_side(blocks: _DenseBlocks):
+    def one_side(blocks: _DenseBlocks, n_opp: int):
         nb = len(blocks.widths)
         kernel = partial(
             _dense_half_step_local,
@@ -555,15 +664,21 @@ def _make_dense_step(mesh, ub: _DenseBlocks, ib: _DenseBlocks, cfg: ALSConfig):
             reg=reg,
             implicit=implicit,
             alpha=alpha,
-            bf16=(cfg.compute_dtype == "bf16"),
+            compute_dtype=cfg.compute_dtype,
+            backend=_resolve_side_backend(cfg, n_opp),
         )
         specs = tuple(P(DATA_AXIS) for _ in range(3 * nb)) + (P(), P())
         return shard_map(
             kernel, mesh=mesh, in_specs=specs, out_specs=P(DATA_AXIS, None)
         )
 
-    u_solve = one_side(ub)
-    v_solve = one_side(ib)
+    # u-solve gathers ITEM factors, v-solve gathers USER factors
+    u_solve = one_side(ub, n_items_pad)
+    v_solve = one_side(ib, n_users_pad)
+    _record_train_kernel_stats(
+        cfg, _resolve_side_backend(cfg, max(n_users_pad, n_items_pad)),
+        n_users_pad, n_items_pad,
+    )
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(U, V, u_bufs, i_bufs):
@@ -584,8 +699,11 @@ def _make_dense_step(mesh, ub: _DenseBlocks, ib: _DenseBlocks, cfg: ALSConfig):
 def _make_step(mesh, ub: _Blocks, ib: _Blocks, cfg: ALSConfig):
     """Build the jitted full ALS iteration over the mesh."""
     rank, reg, alpha, implicit = cfg.rank, cfg.reg, cfg.alpha, cfg.implicit
+    n_shards = mesh.shape[DATA_AXIS]
+    n_users_pad = ub.per_shard * n_shards
+    n_items_pad = ib.per_shard * n_shards
 
-    def one_side(blocks: _Blocks):
+    def one_side(blocks: _Blocks, n_opp: int):
         kernel = partial(
             _half_step_local,
             per_shard=blocks.per_shard,
@@ -593,7 +711,8 @@ def _make_step(mesh, ub: _Blocks, ib: _Blocks, cfg: ALSConfig):
             reg=reg,
             implicit=implicit,
             alpha=alpha,
-            bf16=(cfg.compute_dtype == "bf16"),
+            compute_dtype=cfg.compute_dtype,
+            backend=_resolve_side_backend(cfg, n_opp),
         )
         return shard_map(
             kernel,
@@ -602,8 +721,13 @@ def _make_step(mesh, ub: _Blocks, ib: _Blocks, cfg: ALSConfig):
             out_specs=P(DATA_AXIS, None),
         )
 
-    u_solve = one_side(ub)
-    v_solve = one_side(ib)
+    # u-solve gathers ITEM factors, v-solve gathers USER factors
+    u_solve = one_side(ub, n_items_pad)
+    v_solve = one_side(ib, n_users_pad)
+    _record_train_kernel_stats(
+        cfg, _resolve_side_backend(cfg, max(n_users_pad, n_items_pad)),
+        n_users_pad, n_items_pad,
+    )
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(U, V, u_blocks, i_blocks):
@@ -633,10 +757,22 @@ def _train_devprof(cfg: "ALSConfig", n_ratings: int, n_users: int,
     (read via :func:`obs.devprof.train_snapshot`).
     """
     from predictionio_tpu.obs import devprof
+    from predictionio_tpu.ops import train_kernel as _train_kernel
 
     acc = devprof.train_recorder(platform=jax.default_backend())
-    flops, nbytes = devprof.als_train_cost(
-        n_ratings, n_users, n_items, cfg.rank, cfg.compute_dtype
+    backend = _train_kernel.resolve_backend(getattr(cfg, "train_kernel", None))
+    if backend == "fused":
+        # fused cost model: no gather amplification, V streamed once per
+        # half-step at the compute dtype (obs/devprof.fused_train_cost)
+        flops, nbytes = devprof.fused_train_cost(
+            n_ratings, n_users, n_items, cfg.rank, cfg.compute_dtype
+        )
+    else:
+        flops, nbytes = devprof.als_train_cost(
+            n_ratings, n_users, n_items, cfg.rank, cfg.compute_dtype
+        )
+    _train_kernel.record_stats(
+        intensity_flop_per_byte=(flops / nbytes) if nbytes else None
     )
     n = max(1, int(n_devices))
     key = f"als_iter_r{cfg.rank}"
@@ -792,6 +928,21 @@ def train_als(
     util_acc, util_key = _train_devprof(
         cfg, len(rating), n_users, n_items, n_shards
     )
+    if dense and os.environ.get("PIO_TRAIN_XLA_COST") == "1":
+        # opt-in second compile: annotate the accountant with the
+        # compiler's own cost of the ACTUAL optimized step (fused bytes
+        # included), so MFU divides by what the hardware will really do
+        try:
+            ca = dense_step_cost_analysis(ctx, interactions, cfg)
+            if ca.get("flops_per_iter_per_device"):
+                util_acc.set_cost(
+                    util_key,
+                    ca["flops_per_iter_per_device"],
+                    ca.get("bytes_per_iter_per_device"),
+                    source="xla",
+                )
+        except Exception as e:  # cost annotation must never kill a train
+            logger.warning("PIO_TRAIN_XLA_COST annotation failed: %s", e)
     for it in range(start_iter, cfg.iterations):
         t_step = time.perf_counter()
         U, V = step(U, V, u_blocks, i_blocks)

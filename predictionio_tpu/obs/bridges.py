@@ -241,6 +241,61 @@ def bridge_fastpath(
     registry.register_collector(collect)
 
 
+# -- training: fused gather-contract kernel ----------------------------------
+
+def bridge_train_kernel(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """``ops/train_kernel.stats()`` → pio_train_kernel_* series.
+
+    Training records its resolved dispatch (backend, compute dtype,
+    resident opposite-factor bytes, analytic intensity) into the kernel
+    module's stats dict at step-build time; this bridge snapshots it at
+    scrape so an in-process train (the template train-then-serve flow)
+    is visible on the same ``/metrics`` the serving kernel reports to.
+    Emits nothing before the first train in this process.
+    """
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        fams = [
+            _fam(
+                "pio_train_kernel_info", "gauge",
+                "Active training-kernel backend and compute dtype "
+                "(info gauge, constant 1; the labels are the signal).",
+                [(
+                    "",
+                    (
+                        ("backend", str(s.get("backend", ""))),
+                        ("compute_dtype", str(s.get("compute_dtype", ""))),
+                    ),
+                    1.0,
+                )],
+            ),
+            _fam(
+                "pio_train_kernel_resident_bytes", "gauge",
+                "VMEM-resident opposite-factor bytes per half-step (the "
+                "one sequential V read; narrowed by the compute dtype).",
+                [("", (), _num(s.get("resident_bytes")))],
+            ),
+        ]
+        if s.get("intensity_flop_per_byte") is not None:
+            fams.append(
+                _fam(
+                    "pio_train_kernel_intensity_flop_per_byte", "gauge",
+                    "Analytic arithmetic intensity of one training "
+                    "iteration under the resolved backend; fused ≫ "
+                    "reference because the gather never touches HBM.",
+                    [("", (), _num(s.get("intensity_flop_per_byte")))],
+                )
+            )
+        return fams
+
+    registry.register_collector(collect)
+
+
 # -- serving: sharded factor placement ---------------------------------------
 
 def bridge_sharding(

@@ -37,6 +37,9 @@ __all__ = [
     "PEAKS",
     "peak_for",
     "als_train_cost",
+    "als_train_cost_amplified",
+    "fused_train_cost",
+    "fused_train_vread_bytes",
     "train_utilization",
     "score_cost",
     "DeviceUtilization",
@@ -121,6 +124,81 @@ def train_utilization(
 # bytes per factor element by serving dtype (mirrors ops/quantize.py;
 # duplicated here so the obs layer never imports the ops layer)
 _FACTOR_BYTES = {"f32": 4.0, "bf16": 2.0, "int8": 1.0}
+
+# XLA's TPU row gather reads one sector per row regardless of row width —
+# the read-amplification constant docs/perf_roofline.md derives (~512 B
+# per 40 B factor row at rank 10).
+SECTOR_BYTES = 512.0
+
+
+def als_train_cost_amplified(
+    n_ratings: int, n_users: int, n_items: int, rank: int, dtype: str = "f32"
+) -> tuple[float, float]:
+    """:func:`als_train_cost` with the gather term XLA actually pays.
+
+    The plain model charges ``k·s`` bytes per gathered factor row; on TPU
+    the XLA gather reads a full ~512 B sector per row (``SECTOR_BYTES``),
+    a ~12.8× amplification at rank 10 f32 that dominates the half-step's
+    bytes.  This is the honest reference-backend roofline the fused
+    kernel's intensity is compared against in ``bench.py``.
+    """
+    k = rank
+    s = _FACTOR_BYTES.get(dtype, 4.0)
+    flops, _ = als_train_cost(n_ratings, n_users, n_items, rank, dtype)
+    ents = n_users + n_items
+    nbytes = (
+        n_ratings * 2 * (max(SECTOR_BYTES, k * s) + 12)  # sector reads
+        + ents * k * (4 + s)  # factor write (f32) + opposite read
+    )
+    return float(flops), float(nbytes)
+
+
+def fused_train_vread_bytes(
+    n_users: int, n_items: int, rank: int, compute_dtype: str = "f32"
+) -> float:
+    """Bytes of the fused kernel's ONE sequential opposite-factor read per
+    iteration (both half-steps): each side streams the other side's
+    matrix into VMEM once at the compute dtype, plus the per-row f32
+    scale column when int8.  This is the term the compute dtype narrows —
+    the bench gate holds int8 to ≤ 0.5× the f32 value.
+    """
+    s = _FACTOR_BYTES.get(compute_dtype, 4.0)
+    ents = float(n_users + n_items)
+    nbytes = ents * rank * s
+    if compute_dtype == "int8":
+        nbytes += ents * 4.0
+    return nbytes
+
+
+def fused_train_cost(
+    n_ratings: int, n_users: int, n_items: int, rank: int,
+    compute_dtype: str = "f32",
+) -> tuple[float, float]:
+    """Analytic (FLOPs, HBM bytes) of ONE FUSED-kernel ALS iteration.
+
+    The Pallas training kernel (``ops/train_kernel.py``) streams the
+    opposite factor matrix into VMEM once per half-step and gathers rows
+    against VMEM, so the per-rating gather term — ``SECTOR_BYTES`` under
+    XLA, ``k·s`` even in the charitable model — disappears from HBM
+    entirely.  What remains:
+
+    * per rating, both sides: 12 B of idx/rat/msk stream;
+    * per half-step: the one sequential opposite-matrix read at the
+      compute dtype (:func:`fused_train_vread_bytes`);
+    * per entity: the k·4 f32 factor write.
+
+    FLOPs match :func:`als_train_cost` — same contraction, same Cholesky;
+    the fused win is bytes, i.e. arithmetic intensity.
+    """
+    k = rank
+    flops, _ = als_train_cost(n_ratings, n_users, n_items, rank)
+    ents = n_users + n_items
+    nbytes = (
+        n_ratings * 2 * 12.0  # idx/rat/msk streams, both sides
+        + fused_train_vread_bytes(n_users, n_items, rank, compute_dtype)
+        + ents * k * 4.0  # solved-factor write (always f32)
+    )
+    return float(flops), float(nbytes)
 
 
 def score_cost(

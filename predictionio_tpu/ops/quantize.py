@@ -58,6 +58,31 @@ def quantize_factors(
     )
 
 
+def quantize_factors_jax(factors, dtype: str):
+    """In-graph (jnp) counterpart of :func:`quantize_factors`.
+
+    The TRAINING compute path (``PIO_ALS_COMPUTE_DTYPE``) quantizes the
+    opposite factor matrix once per half-step — the factors change every
+    iteration, so the offline numpy path cannot serve it.  Same math:
+    bf16 is a plain downcast, int8 is symmetric per-row (``row ≈
+    q.astype(f32) * scale``).  Returns ``(quantized, scale-or-None)``.
+    """
+    import jax.numpy as jnp
+
+    if dtype == "f32":
+        return factors, None
+    if dtype == "bf16":
+        return factors.astype(jnp.bfloat16), None
+    if dtype == "int8":
+        amax = jnp.max(jnp.abs(factors), axis=1, keepdims=True)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(factors / scale), -127, 127).astype(jnp.int8)
+        return q, scale
+    raise ValueError(
+        f"factor dtype must be one of {FACTOR_DTYPES}, got {dtype!r}"
+    )
+
+
 def dequantize_factors(
     quantized: np.ndarray, scale: Optional[np.ndarray] = None
 ) -> np.ndarray:

@@ -461,6 +461,14 @@ class QueryServer:
                 return cache.stats_dict()
         return None
 
+    @staticmethod
+    def _train_kernel_stats() -> Optional[dict]:
+        """Training-kernel dispatch stats recorded by the most recent
+        in-process train (None until one runs)."""
+        from predictionio_tpu.ops import train_kernel
+
+        return train_kernel.stats() or None
+
     def _register_metrics(self) -> None:
         """Expose every scattered serving stat on the obs registry, making
         ``/metrics`` the single source of truth for this server."""
@@ -500,6 +508,10 @@ class QueryServer:
             lambda: (self._fastpath_stats() or {}).get("devprof"),
             lambda: self._serving_gen,
         )
+        # pio_train_kernel_*: the fused-training-kernel dispatch recorded
+        # by the most recent in-process train (empty — and silent — until
+        # one runs, e.g. the template train-then-serve flow)
+        _bridges.bridge_train_kernel(reg, self._train_kernel_stats)
         if self._result_cache is not None:
             _bridges.bridge_result_cache(reg, self._result_cache.stats)
         reg.gauge_fn(

@@ -205,6 +205,32 @@ HOTPATH_FIXTURE = {
             pl.pallas_call(partial(_bad_partial_kern, block=8),
                            out_shape=shape)(x)
     """,
+    # Variable-assigned partial kernels (ops/train_kernel.py idiom:
+    # `kern = partial(_kern, ...)` specialised above the launch) must
+    # register as traced exactly like the inline form — bound keywords
+    # static, host syncs inside the body still firing.
+    "ops/train_kern.py": """\
+        from functools import partial
+
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _assigned_kern(x_ref, o_ref, *, block, flag):
+            if flag:
+                o_ref[...] = x_ref[...] * 2.0
+            else:
+                o_ref[...] = x_ref[...]
+
+        def _bad_assigned_kern(x_ref, o_ref, *, block):
+            v = x_ref[...]
+            o_ref[...] = float(v)
+
+        def launch(x, shape):
+            kern = partial(_assigned_kern, block=8, flag=True)
+            pl.pallas_call(kern, out_shape=shape)(x)
+            bad = partial(_bad_assigned_kern, block=8)
+            pl.pallas_call(bad, out_shape=shape)(x)
+    """,
 }
 
 
@@ -214,6 +240,7 @@ def test_hotpath_positives_and_negatives(tmp_path):
     assert symbols(rep, "hotpath-traced-branch") == {"bad_branch.x"}
     assert symbols(rep, "hotpath-host-sync") == {
         "bad_sync.float", "_bad_partial_kern.float",
+        "_bad_assigned_kern.float",
     }
     assert symbols(rep, "hotpath-traced-loop") == {"bad_loop.xs"}
     assert symbols(rep, "hotpath-block-sync") == {"handle_query"}
@@ -224,6 +251,7 @@ def test_hotpath_positives_and_negatives(tmp_path):
     assert not any("ok_static" in s or "ok_shape" in s or
                    "warmup" in s or "_compile" in s for s in all_syms)
     assert not any(s.startswith("_kern.") for s in all_syms)
+    assert not any(s.startswith("_assigned_kern.") for s in all_syms)
 
 
 # -- races --------------------------------------------------------------------

@@ -45,6 +45,51 @@ class TestCostModels:
         assert bf16[0] == f32[0]
         assert bf16[1] < f32[1]
 
+    def test_als_train_cost_amplified_matches_published_formula(self):
+        k, nr, nu, ni = 10, 1000, 50, 40
+        flops, nbytes = devprof.als_train_cost_amplified(nr, nu, ni, k)
+        ents = nu + ni
+        # same FLOPs as the plain model — amplification is bytes-only
+        assert flops == devprof.als_train_cost(nr, nu, ni, k)[0]
+        assert nbytes == nr * 2 * (devprof.SECTOR_BYTES + 12) + ents * k * (
+            4 + 4
+        )
+
+    def test_amplified_sector_floor_only_binds_narrow_rows(self):
+        # rank 10 f32 rows are 40 B < 512 B sector: amplified
+        narrow = devprof.als_train_cost_amplified(1000, 50, 40, 10)
+        plain = devprof.als_train_cost(1000, 50, 40, 10)
+        assert narrow[1] > plain[1]
+        # a 256-wide f32 row already spans 1024 B > sector: no change
+        wide_amp = devprof.als_train_cost_amplified(1000, 50, 40, 256)
+        wide = devprof.als_train_cost(1000, 50, 40, 256)
+        assert wide_amp[1] == wide[1]
+
+    def test_fused_train_cost_matches_published_formula(self):
+        k, nr, nu, ni = 10, 1000, 50, 40
+        for cd in ("f32", "bf16", "int8"):
+            flops, nbytes = devprof.fused_train_cost(nr, nu, ni, k, cd)
+            assert flops == devprof.als_train_cost(nr, nu, ni, k)[0]
+            assert nbytes == (
+                nr * 2 * 12.0
+                + devprof.fused_train_vread_bytes(nu, ni, k, cd)
+                + (nu + ni) * k * 4.0
+            )
+
+    def test_fused_vread_int8_at_most_half_of_f32(self):
+        f32 = devprof.fused_train_vread_bytes(162_000, 59_000, 10, "f32")
+        int8 = devprof.fused_train_vread_bytes(162_000, 59_000, 10, "int8")
+        assert f32 == (162_000 + 59_000) * 10 * 4.0
+        assert int8 == (162_000 + 59_000) * (10 * 1.0 + 4.0)  # +scale col
+        assert int8 <= 0.5 * f32  # the bench_matrix gate's bound
+
+    def test_fused_intensity_beats_amplified_reference_every_dtype(self):
+        nr, nu, ni, k = 25_000_000, 162_000, 59_000, 10
+        rf, rb = devprof.als_train_cost_amplified(nr, nu, ni, k)
+        for cd in ("f32", "bf16", "int8"):
+            ff, fb = devprof.fused_train_cost(nr, nu, ni, k, cd)
+            assert ff / fb > rf / rb  # strictly, per the bench gate
+
     def test_score_cost_scales_with_batch_and_items(self):
         f1, b1 = devprof.score_cost(1, 400, 8)
         f16, b16 = devprof.score_cost(16, 400, 8)

@@ -33,8 +33,8 @@ OUT = os.path.join(REPO, "BENCH_TPU_MANUAL.json")
 _PIN = {"BENCH_REBALANCE": "1", "BENCH_DTYPE": "f32"}
 _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
          "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0",
-         "BENCH_KERNEL": "0", "BENCH_FLEET": "0", "BENCH_ELASTIC": "0",
-         "BENCH_SHARDED": "0"}
+         "BENCH_KERNEL": "0", "BENCH_TRAIN_KERNEL": "0", "BENCH_FLEET": "0",
+         "BENCH_ELASTIC": "0", "BENCH_SHARDED": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -204,6 +204,24 @@ def main() -> int:
         ),
         "gate_pass": kern.get("gate_pass"),
     }
+    # train-kernel gate (ISSUE 13): the fused gather-contract TRAINING
+    # kernel must price strictly above the sector-amplified reference on
+    # the analytic intensity model for every compute dtype, the int8
+    # compute path's one-pass V read must be ≤ half the f32 bytes, and
+    # fused-vs-reference f32 factors must come out bit-equal on the cell's
+    # live equivalence train (measured updates/s gain rides along on TPU)
+    tkern = primary.get("train_kernel") or {}
+    tk_f32 = (tkern.get("dtypes") or {}).get("f32") or {}
+    artifact["train_kernel"] = {
+        "intensity_gain_f32": tkern.get("intensity_gain_f32"),
+        "int8_vread_vs_f32": tkern.get("int8_vread_vs_f32"),
+        "factors_bit_equal_f32": tkern.get("factors_bit_equal_f32"),
+        "measured_gain_f32": tk_f32.get("measured_gain"),
+        "measured_updates_per_sec_f32": tk_f32.get(
+            "measured_updates_per_sec"
+        ),
+        "gate_pass": tkern.get("gate_pass"),
+    }
     # fleet gate (ISSUE 10): with one injected slow replica, hedged p99
     # must come in at or under HALF the unhedged p99, and a rolling
     # deploy under load must be invisible to clients (zero non-200s) —
@@ -304,6 +322,7 @@ def main() -> int:
         "observability": artifact["observability"],
         "serving_utilization": artifact["serving_utilization"],
         "kernel": artifact["kernel"],
+        "train_kernel": artifact["train_kernel"],
         "fleet": artifact["fleet"],
         "multichip": artifact["multichip"],
         "analysis": artifact["analysis"],
