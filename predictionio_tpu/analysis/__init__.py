@@ -19,6 +19,17 @@ modes that actually bite a JAX serving stack:
 * ``hygiene``  — the original lint gates (unused imports, parse health,
   ad-hoc counters/caches) migrated into the framework.
 
+The interprocedural engine (:mod:`callgraph`: whole-repo call graph +
+per-function lock summaries over the same ``RepoIndex`` parse cache)
+powers three more:
+
+* ``lockorder``  — global lock-order graph; cycles across call chains
+  are reported as potential AB/BA deadlocks with witness chains;
+* ``deadline``   — the ``X-Request-Deadline`` contract verified along
+  call-graph reachability from request entry points;
+* ``collective`` — shard_map/mesh axis consistency, pallas_call
+  index_map arity, and host-sync taint extended one call deep.
+
 Entry points: ``pio analyze`` in the CLI, :func:`run` for tests and
 ``tools/bench_matrix.py``.  Findings at severity ``error`` gate tier-1
 via ``tests/test_analysis.py``.
@@ -37,11 +48,20 @@ from predictionio_tpu.analysis.core import (
     run,
     write_baseline,
 )
+from predictionio_tpu.analysis.core import (
+    prune_baseline,
+    stale_baseline_keys,
+    to_sarif,
+)
+from predictionio_tpu.analysis import callgraph
 from predictionio_tpu.analysis import (  # registers the analyzers
     blocking,
+    collective,
+    deadline,
     hotpath,
     hygiene,
     knobs,
+    lockorder,
     metrics_contract,
     races,
 )
@@ -56,12 +76,19 @@ __all__ = [
     "Report",
     "RULES",
     "blocking",
+    "callgraph",
+    "collective",
+    "deadline",
     "hotpath",
     "hygiene",
     "knobs",
     "load_baseline",
+    "lockorder",
     "metrics_contract",
+    "prune_baseline",
     "races",
     "run",
+    "stale_baseline_keys",
+    "to_sarif",
     "write_baseline",
 ]

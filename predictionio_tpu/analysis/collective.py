@@ -1,0 +1,736 @@
+"""Mesh/collective consistency checks for the TPU-native device code.
+
+The sharded serving path (PR 12) and the fused kernels (PRs 9/13) wire
+three contracts that fail at runtime — on a TPU, possibly only at a
+specific device count — if misused:
+
+* **shard_map axis names** must exist on the declaring mesh, and
+  collectives (``psum``/``all_gather``/``axis_index``/…) inside the
+  mapped function must name axes that are actually in scope (appear in
+  the ``in_specs``/``out_specs``).  A typo'd axis is an XLA error at
+  trace time on the pod, long after CI passed on CPU.
+* **pallas_call index_map arity** must equal ``len(grid)`` plus
+  ``num_scalar_prefetch`` (scalar-prefetch refs are appended to the
+  index_map arguments — see ``ops/score_kernel.py``); a mismatch is a
+  TypeError at first launch on the serving host.
+* **host sync in callees of traced code** — hotpath catches ``.item()``
+  and value-branches inside a jitted function's own body; this extends
+  the same taint one call deep into repo-resolved callees (the
+  ``shard_map``-mapped closure calling ``gather_score_topk`` pattern),
+  so a helper that branches on a sharded value is caught even though the
+  helper itself carries no ``@jit``.
+
+Every check is **resolution-gated**: axis names, mesh axes, grid ranks
+and index_map arities are checked only when they statically resolve
+(string constants, module constants chased through imports, local
+assignments).  Anything dynamic — parameterised axis names (``ring.py``
+takes ``axis`` as an argument), meshes built from runtime device counts
+— is skipped, never guessed: a finding from this analyzer is a real
+inconsistency, not a heuristic.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from predictionio_tpu.analysis import callgraph
+from predictionio_tpu.analysis.core import (
+    Finding,
+    Module,
+    RepoIndex,
+    analyzer,
+    finding,
+    rule,
+)
+from predictionio_tpu.analysis.hotpath import (
+    _live_taint,
+    _SYNC_CASTS,
+    _SYNC_METHODS,
+    traced_functions,
+)
+
+R_MESH_AXIS = rule(
+    "collective-mesh-axis",
+    "error",
+    "shard_map names an axis that does not exist on the declaring mesh",
+    "the call fails at trace time with an axis-name error — on the pod, "
+    "not in CPU CI",
+)
+R_UNKNOWN_AXIS = rule(
+    "collective-unknown-axis",
+    "error",
+    "collective inside shard_map names an axis not in scope",
+    "psum/all_gather over an unbound axis name is an XLA error at trace "
+    "time; over the WRONG bound axis it is silently wrong math",
+)
+R_INDEX_MAP_ARITY = rule(
+    "collective-index-map-arity",
+    "error",
+    "BlockSpec index_map arity != len(grid) + num_scalar_prefetch",
+    "Pallas passes one argument per grid dimension plus one per "
+    "prefetched scalar ref; a mismatch is a TypeError at first launch",
+)
+R_HOST_IN_CALLEE = rule(
+    "collective-host-in-callee",
+    "error",
+    "host sync / value branch on a traced argument inside a callee of "
+    "traced code",
+    "the callee runs under the caller's trace; .item()/if on a traced "
+    "parameter forces a host round trip or fails exactly like it would "
+    "in the jitted body itself",
+)
+
+_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+    "axis_index", "all_to_all", "psum_scatter", "pcast_varying",
+}
+# collectives whose axis rides in positional slot 0 (no value operand)
+_AXIS_ARG0 = {"axis_index"}
+
+
+def _call_name(n: ast.Call) -> str:
+    return (
+        n.func.attr if isinstance(n.func, ast.Attribute)
+        else getattr(n.func, "id", "")
+    )
+
+
+class _Consts:
+    """String-constant resolution: locals in the enclosing function,
+    module-level constants, and constants imported from other modules."""
+
+    def __init__(self, index: RepoIndex, mod: Module):
+        self.index = index
+        self.mod = mod
+        self.module_consts = self._module_consts(mod)
+        self.imports: dict[str, tuple[str, str]] = {}
+        if mod.tree is not None:
+            pkg_parts = mod.rel[:-3].split("/")
+            if pkg_parts and pkg_parts[-1] == "__init__":
+                pkg_parts = pkg_parts[:-1]
+            pkg = ".".join(pkg_parts[:-1])
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ImportFrom):
+                    target = node.module or ""
+                    if node.level:
+                        base = pkg.split(".") if pkg else []
+                        if node.level > 1:
+                            base = base[: len(base) - (node.level - 1)]
+                        if node.module:
+                            base += node.module.split(".")
+                        target = ".".join(base)
+                    for a in node.names:
+                        self.imports[a.asname or a.name] = (target, a.name)
+
+    @staticmethod
+    def _module_consts(mod: Module) -> dict[str, str]:
+        out: dict[str, str] = {}
+        if mod.tree is None:
+            return out
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                out[node.targets[0].id] = node.value.value
+        return out
+
+    def resolve(self, expr: ast.expr, local: dict[str, str]) -> Optional[str]:
+        """expr → string constant, or None when not statically known."""
+        if isinstance(expr, ast.Constant):
+            return expr.value if isinstance(expr.value, str) else None
+        if isinstance(expr, ast.Name):
+            if expr.id in local:
+                return local[expr.id]
+            if expr.id in self.module_consts:
+                return self.module_consts[expr.id]
+            imp = self.imports.get(expr.id)
+            if imp is not None:
+                base = imp[0].replace(".", "/")
+                for rel in (base + ".py", base + "/__init__.py"):
+                    m = self.index.module(rel)
+                    if m is not None:
+                        return self._module_consts(m).get(imp[1])
+        if isinstance(expr, ast.Attribute):
+            # mod.CONST: one-module-hop resolution
+            base = expr.value
+            if isinstance(base, ast.Name):
+                imp = self.imports.get(base.id)
+                if imp is not None:
+                    target = f"{imp[0]}.{imp[1]}" if imp[0] else imp[1]
+                    p = target.replace(".", "/")
+                    for rel in (p + ".py", p + "/__init__.py"):
+                        m = self.index.module(rel)
+                        if m is not None:
+                            return self._module_consts(m).get(expr.attr)
+        return None
+
+
+def _local_str_assigns(fn: ast.AST) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name) and \
+                isinstance(n.value, ast.Constant) and \
+                isinstance(n.value.value, str):
+            out[n.targets[0].id] = n.value.value
+    return out
+
+
+def _local_assigns(fn: ast.AST) -> dict[str, ast.expr]:
+    out: dict[str, ast.expr] = {}
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name):
+            out[n.targets[0].id] = n.value
+    return out
+
+
+def _enclosing_fn(node: ast.AST, parents: dict) -> Optional[ast.AST]:
+    p = parents.get(node)
+    while p is not None and not isinstance(
+        p, (ast.FunctionDef, ast.AsyncFunctionDef)
+    ):
+        p = parents.get(p)
+    return p
+
+
+# -- shard_map axis checks -----------------------------------------------------
+
+
+def _spec_axes(
+    expr: ast.expr,
+    consts: _Consts,
+    local_str: dict[str, str],
+    local_assigns: dict[str, ast.expr],
+    depth: int = 0,
+) -> tuple[set[str], bool]:
+    """Axis names mentioned in an in_specs/out_specs expression.
+
+    Returns (axes, fully_resolved).  Any element that cannot be resolved
+    to a string constant, None, or a nested structure of those marks the
+    result unresolved — callers must then skip, not guess.
+    """
+    axes: set[str] = set()
+    resolved = True
+    if depth > 4:
+        return axes, False
+
+    def visit_p_arg(a: ast.expr) -> None:
+        nonlocal resolved
+        if isinstance(a, ast.Constant):
+            if isinstance(a.value, str):
+                axes.add(a.value)
+            elif a.value is not None:
+                resolved = False
+            return
+        if isinstance(a, ast.Tuple):
+            for e in a.elts:
+                visit_p_arg(e)
+            return
+        s = consts.resolve(a, local_str)
+        if s is not None:
+            axes.add(s)
+        else:
+            resolved = False
+
+    if isinstance(expr, ast.Call):
+        fname = _call_name(expr)
+        if fname in ("P", "PartitionSpec"):
+            for a in expr.args:
+                if isinstance(a, ast.Starred):
+                    resolved = False
+                    continue
+                visit_p_arg(a)
+            return axes, resolved
+        return axes, False
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        for e in expr.elts:
+            sub, ok = _spec_axes(e, consts, local_str, local_assigns,
+                                 depth + 1)
+            axes |= sub
+            resolved &= ok
+        return axes, resolved
+    if isinstance(expr, ast.Name) and expr.id in local_assigns:
+        return _spec_axes(local_assigns[expr.id], consts, local_str,
+                          local_assigns, depth + 1)
+    return axes, False
+
+
+def _mesh_axes(
+    expr: ast.expr,
+    consts: _Consts,
+    local_str: dict[str, str],
+    local_assigns: dict[str, ast.expr],
+    depth: int = 0,
+) -> Optional[set[str]]:
+    """Statically-known axis names of a mesh expression, else None."""
+    if depth > 4:
+        return None
+    if isinstance(expr, ast.Name) and expr.id in local_assigns:
+        return _mesh_axes(local_assigns[expr.id], consts, local_str,
+                          local_assigns, depth + 1)
+    if not isinstance(expr, ast.Call):
+        return None
+    fname = _call_name(expr)
+    if fname == "make_mesh":
+        for kw in expr.keywords:
+            if kw.arg == "axes" and isinstance(kw.value, ast.Dict):
+                out: set[str] = set()
+                for k in kw.value.keys:
+                    s = consts.resolve(k, local_str) if k is not None \
+                        else None
+                    if s is None:
+                        return None
+                    out.add(s)
+                return out
+        if expr.args and isinstance(expr.args[0], ast.Dict):
+            out = set()
+            for k in expr.args[0].keys:
+                s = consts.resolve(k, local_str) if k is not None else None
+                if s is None:
+                    return None
+                out.add(s)
+            return out
+        return None
+    if fname == "Mesh" and len(expr.args) >= 2 and isinstance(
+        expr.args[1], (ast.Tuple, ast.List)
+    ):
+        out = set()
+        for e in expr.args[1].elts:
+            s = consts.resolve(e, local_str)
+            if s is None:
+                return None
+            out.add(s)
+        return out
+    return None
+
+
+def _used_axes(
+    scope_fn: ast.AST,
+    consts: _Consts,
+    local_str: dict[str, str],
+) -> list[tuple[str, int, str]]:
+    """(axis, line, via) for every statically-resolvable axis name a
+    collective or a ``partial(..., axis_name=...)`` binding uses inside
+    ``scope_fn`` (nested defs included — the mapped closure lives there)."""
+    out: list[tuple[str, int, str]] = []
+
+    def add_axis_expr(a: ast.expr, line: int, via: str) -> None:
+        if isinstance(a, ast.Tuple):
+            for e in a.elts:
+                add_axis_expr(e, line, via)
+            return
+        s = consts.resolve(a, local_str)
+        if s is not None:
+            out.append((s, line, via))
+
+    for n in ast.walk(scope_fn):
+        if not isinstance(n, ast.Call):
+            continue
+        cname = _call_name(n)
+        if cname in _COLLECTIVES:
+            axis_expr: Optional[ast.expr] = None
+            for kw in n.keywords:
+                if kw.arg in ("axis_name", "axis_index_groups"):
+                    if kw.arg == "axis_name":
+                        axis_expr = kw.value
+            if axis_expr is None:
+                pos = 0 if cname in _AXIS_ARG0 else 1
+                if len(n.args) > pos:
+                    axis_expr = n.args[pos]
+            if axis_expr is not None:
+                add_axis_expr(axis_expr, n.lineno, cname)
+        elif cname == "partial":
+            for kw in n.keywords:
+                if kw.arg == "axis_name":
+                    add_axis_expr(kw.value, n.lineno, "partial")
+    return out
+
+
+def _check_shard_maps(
+    index: RepoIndex, mod: Module, consts: _Consts
+) -> list[Finding]:
+    out: list[Finding] = []
+    if mod.tree is None:
+        return out
+    parents = mod.parents()
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node) == "shard_map"):
+            continue
+        encl = _enclosing_fn(node, parents) or mod.tree
+        local_str = _local_str_assigns(encl)
+        local_assigns = _local_assigns(encl)
+        in_specs = out_specs = mesh_expr = None
+        for kw in node.keywords:
+            if kw.arg == "in_specs":
+                in_specs = kw.value
+            elif kw.arg == "out_specs":
+                out_specs = kw.value
+            elif kw.arg == "mesh":
+                mesh_expr = kw.value
+        scope: set[str] = set()
+        fully = True
+        for spec in (in_specs, out_specs):
+            if spec is None:
+                fully = False
+                continue
+            axes, ok = _spec_axes(spec, consts, local_str, local_assigns)
+            scope |= axes
+            fully &= ok
+        # mesh consistency: only when BOTH sides are statically known
+        if mesh_expr is not None and scope:
+            mesh = _mesh_axes(mesh_expr, consts, local_str, local_assigns)
+            if mesh is not None:
+                for ax in sorted(scope - mesh):
+                    out.append(finding(
+                        R_MESH_AXIS, mod, node.lineno,
+                        f"shard_map spec names axis {ax!r} but the "
+                        f"declaring mesh has axes {sorted(mesh)}",
+                        symbol=ax,
+                    ))
+        # in-scope collectives: only when the spec universe is complete
+        if not fully or not scope:
+            continue
+        for ax, line, via in _used_axes(encl, consts, local_str):
+            if ax not in scope:
+                out.append(finding(
+                    R_UNKNOWN_AXIS, mod, line,
+                    f"{via} names axis {ax!r} inside a shard_map whose "
+                    f"specs only bind {sorted(scope)}",
+                    symbol=ax,
+                ))
+    return out
+
+
+# -- pallas index_map arity ----------------------------------------------------
+
+
+def _int_const(expr: ast.expr) -> Optional[int]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return expr.value
+    return None
+
+
+def _grid_rank(
+    expr: ast.expr, local_assigns: dict[str, ast.expr], depth: int = 0
+) -> Optional[int]:
+    if depth > 4:
+        return None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return len(expr.elts)
+    if isinstance(expr, ast.Name) and expr.id in local_assigns:
+        return _grid_rank(local_assigns[expr.id], local_assigns, depth + 1)
+    if _int_const(expr) is not None:
+        return 1  # grid=8 is shorthand for a rank-1 grid
+    return None
+
+
+def _index_map_arity(
+    expr: ast.expr,
+    fn_defs: dict[str, ast.AST],
+) -> Optional[int]:
+    if isinstance(expr, ast.Lambda):
+        a = expr.args
+        return len(a.posonlyargs) + len(a.args)
+    if isinstance(expr, ast.Name) and expr.id in fn_defs:
+        a = fn_defs[expr.id].args
+        return len(a.posonlyargs) + len(a.args)
+    return None
+
+
+def _blockspecs_of(
+    expr: Optional[ast.expr],
+    encl: ast.AST,
+) -> list[ast.Call]:
+    """BlockSpec calls reachable from a specs kwarg: the expression
+    itself, or — when it's a name — the list assignments and
+    ``.append(...)`` calls building that name in the enclosing scope
+    (the conditional-specs idiom in ops/score_kernel.py)."""
+    if expr is None:
+        return []
+    roots: list[ast.expr] = [expr]
+    if isinstance(expr, ast.Name):
+        for n in ast.walk(encl):
+            if isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == expr.id
+                for t in n.targets
+            ):
+                roots.append(n.value)
+            elif isinstance(n, ast.AugAssign) and isinstance(
+                n.target, ast.Name
+            ) and n.target.id == expr.id:
+                roots.append(n.value)
+            elif isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute
+            ) and n.func.attr in ("append", "extend", "insert") and \
+                    isinstance(n.func.value, ast.Name) and \
+                    n.func.value.id == expr.id:
+                roots.extend(n.args)
+    out = []
+    for r in roots:
+        for n in ast.walk(r):
+            if isinstance(n, ast.Call) and _call_name(n) == "BlockSpec":
+                out.append(n)
+    return out
+
+
+def _check_pallas(mod: Module) -> list[Finding]:
+    out: list[Finding] = []
+    if mod.tree is None:
+        return out
+    parents = mod.parents()
+    fn_defs = {
+        n.name: n for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node) == "pallas_call"):
+            continue
+        encl = _enclosing_fn(node, parents) or mod.tree
+        local_assigns = _local_assigns(encl)
+        grid_expr = grid_spec_expr = None
+        spec_exprs: list[ast.expr] = []
+        for kw in node.keywords:
+            if kw.arg == "grid":
+                grid_expr = kw.value
+            elif kw.arg == "grid_spec":
+                grid_spec_expr = kw.value
+            elif kw.arg in ("in_specs", "out_specs"):
+                spec_exprs.append(kw.value)
+        prefetch = 0
+        if grid_spec_expr is not None:
+            gs = grid_spec_expr
+            if isinstance(gs, ast.Name) and gs.id in local_assigns:
+                gs = local_assigns[gs.id]
+            if isinstance(gs, ast.Call):
+                for kw in gs.keywords:
+                    if kw.arg == "grid":
+                        grid_expr = kw.value
+                    elif kw.arg == "num_scalar_prefetch":
+                        n = _int_const(kw.value)
+                        if n is None:
+                            grid_expr = None  # dynamic prefetch: skip
+                            break
+                        prefetch = n
+                    elif kw.arg in ("in_specs", "out_specs"):
+                        spec_exprs.append(kw.value)
+        if grid_expr is None:
+            continue
+        rank = _grid_rank(grid_expr, local_assigns)
+        if rank is None:
+            continue
+        expected = rank + prefetch
+        for spec_expr in spec_exprs:
+            for bs in _blockspecs_of(spec_expr, encl):
+                im = None
+                if len(bs.args) >= 2:
+                    im = bs.args[1]
+                else:
+                    for kw in bs.keywords:
+                        if kw.arg == "index_map":
+                            im = kw.value
+                if im is None:
+                    continue  # memory_space-only spec: no index_map
+                arity = _index_map_arity(im, fn_defs)
+                if arity is None or arity == expected:
+                    continue
+                out.append(finding(
+                    R_INDEX_MAP_ARITY, mod, bs.lineno,
+                    f"BlockSpec index_map takes {arity} arg(s) but the "
+                    f"grid is rank {rank}"
+                    + (f" with {prefetch} prefetched scalar(s)"
+                       if prefetch else "")
+                    + f" — Pallas will pass {expected}",
+                    symbol=f"L{bs.lineno}",
+                ))
+    return out
+
+
+# -- host sync one call deep ---------------------------------------------------
+
+
+def _shard_mapped_fns(mod: Module) -> set[str]:
+    """Names of local functions handed to shard_map (they run traced)."""
+    out: set[str] = set()
+    if mod.tree is None:
+        return out
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _call_name(node) == "shard_map" \
+                and node.args and isinstance(node.args[0], ast.Name):
+            out.add(node.args[0].id)
+    return out
+
+
+def _callee_taint_check(
+    index: RepoIndex,
+    graph: callgraph.CallGraph,
+) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in index.modules:
+        if mod.tree is None:
+            continue
+        traced = dict(traced_functions(mod))
+        mapped_names = _shard_mapped_fns(mod)
+        if mapped_names:
+            for n in ast.walk(mod.tree):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n.name in mapped_names and n not in traced:
+                    traced[n] = set()
+        if not traced:
+            continue
+        parents = mod.parents()
+        traced_names = {f.name for f in traced}
+        # map ast fn -> callgraph node for resolved callee lookup
+        node_by_ast = {
+            id(n.ast_node): n
+            for n in graph.nodes.values() if n.rel == mod.rel
+        }
+        for fn, static in traced.items():
+            cg_node = node_by_ast.get(id(fn))
+            if cg_node is None:
+                continue
+            from predictionio_tpu.analysis.hotpath import _taint_set
+
+            tainted = _taint_set(fn, static, parents)
+            sites = {s.line: s for s in cg_node.calls if s.kind == "call"}
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                site = sites.get(call.lineno)
+                if site is None or not site.callees:
+                    continue
+                for callee_qual in site.callees:
+                    callee = graph.nodes.get(callee_qual)
+                    if callee is None or callee.ast_node is None:
+                        continue
+                    if callee.name in traced_names or callee.cls:
+                        continue  # traced callees get their own pass
+                    callee_mod = index.module(callee.rel)
+                    if callee_mod is None:
+                        continue
+                    # taint the callee params bound to tainted args
+                    callee_tainted: set[str] = set()
+                    params = callee.params
+                    for i, a in enumerate(call.args):
+                        if i < len(params) and any(
+                            _live_taint(a, tainted, parents)
+                        ):
+                            callee_tainted.add(params[i])
+                    for kw in call.keywords:
+                        if kw.arg in params and any(
+                            _live_taint(kw.value, tainted, parents)
+                        ):
+                            callee_tainted.add(kw.arg)
+                    if not callee_tainted:
+                        continue
+                    out.extend(_scan_callee(
+                        callee_mod, callee, callee_tainted, cg_node,
+                    ))
+    # a helper called from several traced fns reports once per distinct
+    # (rule, path, symbol) — dedupe keeps the report readable
+    seen: set[str] = set()
+    deduped = []
+    for f in out:
+        if f.key not in seen:
+            seen.add(f.key)
+            deduped.append(f)
+    return deduped
+
+
+def _scan_callee(
+    mod: Module,
+    callee: callgraph.FuncNode,
+    seed: set[str],
+    caller: callgraph.FuncNode,
+) -> list[Finding]:
+    from predictionio_tpu.analysis.hotpath import _taint_set
+
+    fn = callee.ast_node
+    parents = mod.parents()
+    # params NOT in seed are static for this propagation — only the
+    # caller's traced values carry tracer-ness into the callee
+    all_params = set(callee.params)
+    static = all_params - seed
+    tainted = _taint_set(fn, static, parents)
+    out: list[Finding] = []
+    nested = {
+        n for n in ast.walk(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n is not fn
+    }
+
+    def in_nested(node: ast.AST) -> bool:
+        p = parents.get(node)
+        while p is not None and p is not fn:
+            if p in nested:
+                return True
+            p = parents.get(p)
+        return False
+
+    for node in ast.walk(fn):
+        if in_nested(node):
+            continue
+        if isinstance(node, ast.Call):
+            cname = getattr(node.func, "id", "")
+            cattr = node.func.attr if isinstance(
+                node.func, ast.Attribute
+            ) else ""
+            if cname in _SYNC_CASTS and any(
+                any(_live_taint(a, tainted, parents))
+                for a in list(node.args)
+                + [kw.value for kw in node.keywords]
+            ):
+                out.append(finding(
+                    R_HOST_IN_CALLEE, mod, node.lineno,
+                    f"{cname}() on a traced value in {callee.name!r}, "
+                    f"called from traced {caller.name!r}",
+                    symbol=f"{callee.name}.{cname}",
+                ))
+            elif cattr in _SYNC_METHODS and any(
+                _live_taint(node.func.value, tainted, parents)
+            ):
+                out.append(finding(
+                    R_HOST_IN_CALLEE, mod, node.lineno,
+                    f".{cattr}() on a traced value in {callee.name!r}, "
+                    f"called from traced {caller.name!r}",
+                    symbol=f"{callee.name}.{cattr}",
+                ))
+        elif isinstance(node, (ast.If, ast.While)):
+            hits = list(_live_taint(node.test, tainted, parents))
+            if hits:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                out.append(finding(
+                    R_HOST_IN_CALLEE, mod, node.lineno,
+                    f"Python `{kind}` on traced value {hits[0].id!r} in "
+                    f"{callee.name!r}, called from traced "
+                    f"{caller.name!r}",
+                    symbol=f"{callee.name}.{hits[0].id}",
+                ))
+    return out
+
+
+# -- analyzer ------------------------------------------------------------------
+
+
+from predictionio_tpu.analysis.core import owns_rules
+
+owns_rules("collective", R_MESH_AXIS.id, R_UNKNOWN_AXIS.id,
+           R_INDEX_MAP_ARITY.id, R_HOST_IN_CALLEE.id)
+
+
+@analyzer("collective")
+def analyze_collective(index: RepoIndex) -> list[Finding]:
+    graph = callgraph.get(index)
+    out: list[Finding] = []
+    for mod in index.modules:
+        if mod.tree is None:
+            continue
+        consts = _Consts(index, mod)
+        out.extend(_check_shard_maps(index, mod, consts))
+        out.extend(_check_pallas(mod))
+    out.extend(_callee_taint_check(index, graph))
+    return out

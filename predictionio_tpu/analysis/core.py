@@ -200,6 +200,14 @@ class RepoIndex:
                             self.bin_texts[f"bin/{f}"] = fh.read()
                     except UnicodeDecodeError:
                         pass
+        # shell scripts under tools/ are knob readers too (ci_analyze.sh)
+        tools_dir = os.path.join(self.root, "tools")
+        if os.path.isdir(tools_dir):
+            for f in sorted(os.listdir(tools_dir)):
+                if f.endswith(".sh"):
+                    with open(os.path.join(tools_dir, f),
+                              encoding="utf-8") as fh:
+                        self.bin_texts[f"tools/{f}"] = fh.read()
 
     def _iter_py(self) -> Iterable[str]:
         roots = [
@@ -298,6 +306,71 @@ def finding(
 
 BASELINE_NAME = ".pio-analysis-baseline.json"
 
+R_BASELINE_STALE = rule(
+    "baseline-stale", "warning",
+    "baseline entry no longer resolves to an existing rule/file/symbol",
+    "a stale key is acknowledged debt that was already paid (or renamed "
+    "out from under its key); prune it with --prune-baseline so the "
+    "baseline diff stays an honest regression record",
+)
+
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def stale_baseline_keys(
+    keys: Iterable[str], idx: "RepoIndex"
+) -> list[tuple[str, str]]:
+    """Baseline keys that can no longer resolve → ``(key, reason)``.
+
+    A key is ``rule:path:symbol`` (or ``rule:path:line``).  It is stale
+    when the rule id is unknown, the path no longer exists, or — for
+    symbol-anchored keys — an identifier in the symbol no longer appears
+    anywhere in the file's source.  Line-anchored keys are only checked
+    for rule and path (line churn is exactly what symbols exist to
+    absorb, so a surviving line key proves nothing either way).
+    """
+    out: list[tuple[str, str]] = []
+    for key in sorted(set(keys)):
+        parts = key.split(":", 2)
+        if len(parts) != 3:
+            out.append((key, "malformed key"))
+            continue
+        rule_id, path, symbol = parts
+        if rule_id not in RULES:
+            out.append((key, f"unknown rule {rule_id!r}"))
+            continue
+        mod = idx.module(path)
+        if mod is None:
+            if not os.path.isfile(os.path.join(idx.root, path)):
+                out.append((key, f"file {path!r} no longer exists"))
+            continue  # non-module file that still exists: can't check more
+        if symbol.isdigit() or not symbol:
+            continue  # line-anchored: rule+path are all we can verify
+        idents = _IDENT_RE.findall(symbol)
+        missing = [i for i in idents if i not in mod.source]
+        if missing:
+            out.append((
+                key,
+                f"symbol {symbol!r} not found in {path}"
+                f" (missing {', '.join(missing)})",
+            ))
+    return out
+
+
+def prune_baseline(path: str, idx: "RepoIndex") -> list[str]:
+    """Drop stale keys from the baseline file; returns the removed keys."""
+    keys = load_baseline(path)
+    stale = {k for k, _ in stale_baseline_keys(keys, idx)}
+    if not stale:
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    data["findings"] = sorted(set(keys) - stale)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return sorted(stale)
+
 
 def load_baseline(path: str) -> set[str]:
     """Baseline file → set of acknowledged finding keys (missing = empty)."""
@@ -350,12 +423,30 @@ class Report:
     def errors(self) -> int:
         return self.counts["error"]
 
+    @property
+    def by_analyzer(self) -> dict[str, dict[str, int]]:
+        """severity counts per analyzer (rule ownership via the registry;
+        framework findings like baseline-stale land under 'framework')."""
+        owner = {
+            rid: name
+            for name, rids in ANALYZER_RULES.items() for rid in rids
+        }
+        out: dict[str, dict[str, int]] = {
+            name: {s: 0 for s in SEVERITIES} for name in self.analyzers
+        }
+        for f in self.findings:
+            name = owner.get(f.rule, "framework")
+            out.setdefault(name, {s: 0 for s in SEVERITIES})
+            out[name][f.severity] += 1
+        return out
+
     def to_dict(self) -> dict:
         return {
             "version": 1,
             "root": self.root,
             "analyzers": self.analyzers,
             "counts": self.counts,
+            "by_analyzer": self.by_analyzer,
             "suppressed": self.suppressed,
             "baselined": self.baselined,
             "findings": [f.to_dict() for f in self.findings],
@@ -373,6 +464,60 @@ class Report:
             f"{self.baselined} baselined"
         )
         return "\n".join(lines)
+
+
+_SARIF_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def to_sarif(report: Report) -> dict:
+    """Report → SARIF 2.1.0 (one run, one result per active finding).
+
+    ``partialFingerprints.pioKey`` carries the baseline key so SARIF
+    consumers dedupe across line churn the same way the baseline does.
+    """
+    rule_ids = sorted({f.rule for f in report.findings} & set(RULES))
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "pio-analyze",
+                "informationUri": "docs/analysis.md",
+                "rules": [
+                    {
+                        "id": rid,
+                        "shortDescription": {"text": RULES[rid].summary},
+                        "fullDescription": {
+                            "text": RULES[rid].rationale
+                            or RULES[rid].summary
+                        },
+                        "defaultConfiguration": {
+                            "level": _SARIF_LEVELS[RULES[rid].severity],
+                        },
+                    }
+                    for rid in rule_ids
+                ],
+            }},
+            "results": [
+                {
+                    "ruleId": f.rule,
+                    "level": _SARIF_LEVELS.get(f.severity, "note"),
+                    "message": {"text": f.message},
+                    "partialFingerprints": {"pioKey": f.key},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {"startLine": max(1, f.line)},
+                        },
+                    }],
+                }
+                for f in report.findings
+            ],
+        }],
+    }
 
 
 def run(
@@ -397,11 +542,12 @@ def run(
         raise ValueError(
             f"unknown analyzer(s) {unknown}; have {sorted(ANALYZERS)}"
         )
-    baseline = load_baseline(
+    bpath = (
         baseline_path
         if baseline_path is not None
         else os.path.join(idx.root, BASELINE_NAME)
     )
+    baseline = load_baseline(bpath)
     raw: list[Finding] = []
     extras: dict = {}
     for name in names:
@@ -425,6 +571,23 @@ def run(
         if changed_only is not None and f.path not in changed_only:
             continue
         active.append(f)
+    # stale baseline keys are reported (warning), never silently dropped
+    bl_rel = (
+        os.path.relpath(bpath, idx.root).replace(os.sep, "/")
+        if baseline else BASELINE_NAME
+    )
+    for key, reason in stale_baseline_keys(baseline, idx):
+        f = Finding(
+            rule=R_BASELINE_STALE.id,
+            severity=R_BASELINE_STALE.severity,
+            path=bl_rel,
+            line=1,
+            message=f"stale baseline entry {key!r}: {reason}; run "
+                    "`pio analyze --prune-baseline` to drop it",
+            symbol=key,
+        )
+        if changed_only is None or f.path in changed_only:
+            active.append(f)
     active.sort(key=lambda f: (f.path, f.line, f.rule))
     return Report(
         root=idx.root,

@@ -261,6 +261,10 @@ def _live_taint(
             p.func, "id", ""
         ) in {"len", "isinstance", "type"}:
             continue
+        if isinstance(p, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in p.ops
+        ):
+            continue  # `x is None` is identity, not a value read
         yield n
 
 

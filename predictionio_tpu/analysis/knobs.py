@@ -14,8 +14,9 @@ helpers — with its literal default and parse type (from the helper's
 cast arg or an enclosing ``int()``/``float()`` call).  Dynamic families
 built with f-strings (``PIO_STORAGE_SOURCES_<N>_TYPE``) are recorded as
 prefix patterns and matched against the docs' own prefix mentions
-(``PIO_STORAGE_SOURCES_``).  Shell scripts under ``bin/`` count as
-readers so shell-only knobs (``PIO_PID_DIR``) aren't "dead".
+(``PIO_STORAGE_SOURCES_``).  Shell scripts under ``bin/`` and
+``tools/*.sh`` count as readers so shell-only knobs (``PIO_PID_DIR``,
+``PIO_ANALYZE_FULL``) aren't "dead".
 
 The machine-readable registry rides in the JSON report under
 ``knobs`` — the doc tables and this registry must agree exactly.

@@ -38,6 +38,7 @@ import ast
 from dataclasses import dataclass, field
 from typing import Optional
 
+from predictionio_tpu.analysis.callgraph import acquire_intervals
 from predictionio_tpu.analysis.core import (
     Finding, Module, RepoIndex, analyzer, finding, rel_in, rule,
 )
@@ -190,6 +191,25 @@ def _collect_class(mod: Module, cls: ast.ClassDef) -> _ClassInfo:
             else frozenset()
         )
 
+        # explicit acquire()/release() pairs (try/finally idiom) guard
+        # the lines between them just like a `with` block does
+        fn_end = max(
+            (getattr(n, "end_lineno", None)
+             or getattr(n, "lineno", 0) for n in ast.walk(m)),
+            default=m.lineno,
+        )
+
+        def _acq_token(expr: ast.expr, _locks=info.lock_attrs):
+            attr = _is_self_attr(expr)
+            return attr if attr and _lockish(attr, _locks) else None
+
+        intervals = acquire_intervals(m, _acq_token, fn_end)
+
+        def explicit_held(line: int) -> frozenset[str]:
+            return frozenset(
+                iv.token for iv in intervals if iv.covers(line)
+            )
+
         for node in ast.walk(m):
             if in_nested_class(node):
                 continue  # a class defined in a method is its own scope
@@ -210,7 +230,8 @@ def _collect_class(mod: Module, cls: ast.ClassDef) -> _ClassInfo:
                     info.writes.append(_Site(
                         attr=attr, line=node.lineno, rmw=rmw,
                         locks=_locks_held(node, m, parents,
-                                          info.lock_attrs) | caller_held,
+                                          info.lock_attrs) | caller_held
+                        | explicit_held(node.lineno),
                         entry=entry,
                     ))
             elif isinstance(node, ast.AugAssign):
@@ -222,7 +243,8 @@ def _collect_class(mod: Module, cls: ast.ClassDef) -> _ClassInfo:
                     info.writes.append(_Site(
                         attr=attr, line=node.lineno, rmw=True,
                         locks=_locks_held(node, m, parents,
-                                          info.lock_attrs) | caller_held,
+                                          info.lock_attrs) | caller_held
+                        | explicit_held(node.lineno),
                         entry=entry,
                     ))
             elif isinstance(node, ast.Attribute) and \

@@ -92,6 +92,40 @@ def parse_deadline_header(value: Optional[str]) -> Optional[Deadline]:
     return Deadline.after_ms(ms)
 
 
+# ambient deadline: request handlers bind the parsed deadline here so
+# layers with no deadline parameter in their signature (the storage DAO
+# surface, cache fill paths) can still cap their outbound hops.  Same
+# shape as obs._tracing.active_traces(): thread-local, scope-managed,
+# absent ⇒ None (no deadline), never raises.
+_ambient = threading.local()
+
+
+def current_deadline() -> Optional[Deadline]:
+    """The deadline bound to this thread's active request, if any."""
+    stack = getattr(_ambient, "stack", None)
+    return stack[-1] if stack else None
+
+
+class deadline_scope:
+    """``with deadline_scope(d):`` binds ``d`` as the thread's ambient
+    deadline.  ``None`` is a valid binding (explicitly "no deadline" —
+    shadows any outer scope, e.g. a background loop spawned mid-request).
+    Re-entrant; always pops what it pushed."""
+
+    def __init__(self, deadline: Optional[Deadline]):
+        self._deadline = deadline
+
+    def __enter__(self) -> Optional[Deadline]:
+        stack = getattr(_ambient, "stack", None)
+        if stack is None:
+            stack = _ambient.stack = []
+        stack.append(self._deadline)
+        return self._deadline
+
+    def __exit__(self, *exc) -> None:
+        _ambient.stack.pop()
+
+
 # -- retry budget + policy ---------------------------------------------------
 
 

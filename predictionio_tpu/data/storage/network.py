@@ -843,11 +843,23 @@ class _Client:
             # every storage call it causes, so the storage server's trace
             # ring and logs line up with the query's
             headers[_tracing.TRACE_HEADER] = active[0].request_id
+        timeout = self.timeout
+        deadline = resilience.current_deadline()
+        if deadline is not None:
+            # the storage hop inherits the request's remaining budget:
+            # forward it on the wire and never block the socket past it
+            # (floored so an already-expired budget fails fast on connect
+            # instead of degenerating into a non-blocking socket)
+            remaining_s = max(0.05, deadline.remaining_s())
+            headers[resilience.DEADLINE_HEADER] = (
+                f"{max(0.0, deadline.remaining_ms()):.0f}"
+            )
+            timeout = min(timeout, remaining_s) if timeout else remaining_s
         req = urllib.request.Request(
             self.url + path, data=body, method=method, headers=headers
         )
         try:
-            return urllib.request.urlopen(req, timeout=self.timeout)
+            return urllib.request.urlopen(req, timeout=timeout)
         except urllib.error.HTTPError as e:
             try:
                 msg = json.loads(e.read().decode()).get("message", str(e))
@@ -872,6 +884,7 @@ class _Client:
             self.policy,
             breaker=self.breaker_for(path),
             retryable=_retryable,
+            deadline=resilience.current_deadline(),
             on_retry=self._note_retry,
         )
 
@@ -1071,6 +1084,7 @@ class NetworkPEvents(base.PEvents):
                     self._c.policy,
                     breaker=self._c.breaker_for("/pevents/find"),
                     retryable=_retryable,
+                    deadline=resilience.current_deadline(),
                     on_retry=self._c._note_retry,
                 )
             except NetworkStorageError as e:

@@ -41,6 +41,7 @@ from predictionio_tpu.common.resilience import (
     RateLimitedLogger,
     RetryPolicy,
     call_with_resilience,
+    deadline_scope,
     parse_deadline_header,
 )
 from predictionio_tpu import obs
@@ -807,9 +808,15 @@ class QueryServer:
                     method="POST",
                     headers={"Content-Type": "application/json"},
                 )
+                # fire-and-forget by design: feedback is decoupled from
+                # the request that produced it (the caller already got
+                # its answer), so there is no deadline to propagate —
+                # the fixed timeout + breaker bound the loop instead
+                # pio: ignore[deadline-drop]
                 urllib.request.urlopen(req, timeout=5)
 
             try:
+                # pio: ignore[deadline-not-forwarded] (see post() above)
                 call_with_resilience(
                     post,
                     self._feedback_policy,
@@ -984,7 +991,13 @@ class QueryServer:
                         504, {"message": "deadline expired before execution"}
                     )
                 try:
-                    return json_response(200, self.handle_query(data, deadline))
+                    # ambient binding: storage/cache hops under this
+                    # request see the budget via current_deadline() even
+                    # where no deadline parameter reaches them
+                    with deadline_scope(deadline):
+                        return json_response(
+                            200, self.handle_query(data, deadline)
+                        )
                 except DeadlineExceeded as e:
                     return json_response(504, {"message": str(e)})
                 except TypeError as e:

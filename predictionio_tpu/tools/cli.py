@@ -1049,6 +1049,21 @@ def cmd_analyze(args) -> int:
     names = args.analyzers.split(",") if args.analyzers else None
     changed = _git_changed(root) if args.changed_only else None
     baseline_path = args.baseline or os.path.join(root, core.BASELINE_NAME)
+    if args.graph:
+        from predictionio_tpu.analysis import lockorder
+
+        index = core.RepoIndex(root)
+        print(lockorder.to_dot(index), end="")
+        return 0
+    if args.prune_baseline:
+        index = core.RepoIndex(root)
+        removed = core.prune_baseline(baseline_path, index)
+        for key in removed:
+            print(f"[INFO] pruned stale baseline entry {key}")
+        print(f"[INFO] {len(removed)} stale entr"
+              f"{'y' if len(removed) == 1 else 'ies'} pruned from "
+              f"{baseline_path}")
+        return 0
     rep = core.run(
         root,
         analyzers=names,
@@ -1064,6 +1079,8 @@ def cmd_analyze(args) -> int:
         return 0
     if args.format == "json":
         print(json.dumps(rep.to_dict(), indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(core.to_sarif(rep), indent=2))
     else:
         print(rep.render())
     return 1 if rep.errors else 0
@@ -1440,7 +1457,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--root", default=".",
                     help="repo root to analyze (default: cwd)")
-    sp.add_argument("--format", choices=("human", "json"), default="human")
+    sp.add_argument("--format", choices=("human", "json", "sarif"),
+                    default="human")
     sp.add_argument("--analyzers", default=None,
                     help="comma-separated subset (default: all registered)")
     sp.add_argument(
@@ -1458,6 +1476,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    sp.add_argument(
+        "--graph", choices=("lockorder",), default=None,
+        help="dump an analysis graph as DOT instead of findings "
+        "(lockorder: the global lock-order graph, cycles in red)",
+    )
+    sp.add_argument(
+        "--prune-baseline", action="store_true",
+        help="drop baseline entries whose rule/file/symbol no longer "
+        "resolves (reported as baseline-stale warnings otherwise)",
+    )
     sp.set_defaults(func=cmd_analyze)
 
     return p

@@ -435,6 +435,376 @@ def test_blocking_positives_and_negatives(tmp_path):
     assert syms == {"dispatch.sleep", "dispatch.dumps", "_flush.sleep"}
 
 
+# -- lockorder ----------------------------------------------------------------
+
+
+LOCKORDER_CYCLE_FIXTURE = {
+    "serving/ab.py": """\
+        import threading
+
+        class Metrics:
+            def __init__(self):
+                self._m_lock = threading.Lock()
+
+            def record(self):
+                with self._m_lock:
+                    return 1
+
+            def snapshot(self, router: "Router"):
+                with self._m_lock:
+                    return router.peek()
+
+        class Router:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.metrics = Metrics()
+
+            def forward(self):
+                with self._lock:
+                    return self.metrics.record()
+
+            def peek(self):
+                with self._lock:
+                    return 0
+    """,
+}
+
+
+def test_lockorder_detects_ab_ba_cycle_across_calls(tmp_path):
+    root = make_repo(tmp_path, LOCKORDER_CYCLE_FIXTURE)
+    rep = run(root, analyzers=["lockorder"])
+    cyc = by_rule(rep, "lockorder-cycle")
+    assert len(cyc) == 1
+    f = cyc[0]
+    assert "_m_lock" in f.symbol and "_lock" in f.symbol
+    # the witness chains show BOTH sides of the inversion with file:line
+    assert "one side:" in f.message and "other side:" in f.message
+    assert "serving/ab.py:" in f.message
+
+
+def test_lockorder_consistent_order_is_clean(tmp_path):
+    root = make_repo(tmp_path, {
+        "serving/ok.py": """\
+            import threading
+
+            class Inner:
+                def __init__(self):
+                    self._i_lock = threading.Lock()
+
+                def work(self):
+                    with self._i_lock:
+                        return 1
+
+            class Outer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.inner = Inner()
+
+                def a(self):
+                    with self._lock:
+                        return self.inner.work()
+
+                def b(self):
+                    with self._lock:
+                        with self.inner._i_lock:
+                            return 2
+        """,
+    })
+    rep = run(root, analyzers=["lockorder"])
+    assert by_rule(rep, "lockorder-cycle") == []
+
+
+def test_lockorder_report_carries_callgraph_stats(tmp_path):
+    root = make_repo(tmp_path, LOCKORDER_CYCLE_FIXTURE)
+    rep = run(root, analyzers=["lockorder"])
+    stats = rep.extras["callgraph"]
+    assert stats["nodes"] > 0 and stats["resolution_rate"] is not None
+
+
+def test_cli_graph_lockorder_dumps_dot(tmp_path, capsys):
+    from predictionio_tpu.tools.cli import main
+
+    root = make_repo(tmp_path, LOCKORDER_CYCLE_FIXTURE)
+    assert main(["analyze", "--root", root, "--graph", "lockorder"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("digraph lockorder")
+    assert "color=red" in out  # cycle edges are highlighted
+
+
+# -- deadline -----------------------------------------------------------------
+
+
+DEADLINE_FIXTURE = {
+    "serving/handler.py": """\
+        import urllib.request
+
+        def handle_query(req):
+            return fetch_features(req)
+
+        def fetch_features(req):
+            # reachable hop with no deadline contract: must flag
+            return urllib.request.urlopen("http://storage/find", timeout=5)
+
+        def handle_retry(req, policy):
+            return call_with_resilience(lambda: 1, policy)
+
+        def handle_forward(req, headers):
+            headers[DEADLINE_HEADER] = req.headers.get(DEADLINE_HEADER)
+            return headers
+
+        def metrics_loop():
+            # NOT reachable from any request entry: control loops own
+            # their timeouts
+            return urllib.request.urlopen("http://self/stats", timeout=5)
+    """,
+    "serving/clean.py": """\
+        import urllib.request
+
+        def handle_good(req, deadline, policy, pool):
+            headers = {}
+            headers[DEADLINE_HEADER] = f"{deadline.remaining_ms():.0f}"
+            urllib.request.urlopen("http://x/", timeout=1)
+            call_with_resilience(lambda: 1, policy, deadline=deadline)
+            pool.submit(work, deadline=deadline)
+            return headers
+
+        def handle_waived(req):
+            # fire-and-forget by design
+            # pio: ignore[deadline-drop]
+            return urllib.request.urlopen("http://fire/forget", timeout=1)
+    """,
+}
+
+
+def test_deadline_rules_positive_and_negative(tmp_path):
+    root = make_repo(tmp_path, DEADLINE_FIXTURE)
+    rep = run(root, analyzers=["deadline"])
+    drops = symbols(rep, "deadline-drop")
+    # flagged through the call chain (fetch_features has no request verb)
+    assert drops == {"fetch_features"}
+    assert symbols(rep, "deadline-not-forwarded") == {"handle_retry"}
+    assert symbols(rep, "deadline-stale-forward") == {"handle_forward"}
+    assert rep.suppressed == 1  # handle_waived
+
+
+def test_deadline_submit_must_forward_in_hand_deadline(tmp_path):
+    root = make_repo(tmp_path, {
+        "serving/batch.py": """\
+            def handle_batch(req, deadline, pool):
+                return pool.submit(work, req)
+        """,
+    })
+    rep = run(root, analyzers=["deadline"])
+    assert symbols(rep, "deadline-not-forwarded") == {"handle_batch.submit"}
+
+
+# -- collective ---------------------------------------------------------------
+
+
+COLLECTIVE_FIXTURE = {
+    "parallel/dev.py": """\
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def run_bad_mesh(xs):
+            mesh = make_mesh(axes={"data": 2})
+            f = shard_map(body, mesh=mesh, in_specs=(P("model"),),
+                          out_specs=P("model"))
+            return f(xs)
+
+        def run_bad_collective(xs, mesh):
+            def body(x):
+                return jax.lax.psum(x, "model")
+            f = shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                          out_specs=P("data"))
+            return f(xs)
+
+        def run_clean(xs, mesh):
+            def body(x):
+                return jax.lax.psum(x, "data")
+            f = shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                          out_specs=P("data"))
+            return f(xs)
+
+        def run_dynamic_axis(xs, mesh, axis):
+            def body(x):
+                return jax.lax.psum(x, axis)
+            f = shard_map(body, mesh=mesh, in_specs=(P(axis),),
+                          out_specs=P(axis))
+            return f(xs)
+    """,
+    "ops/kern.py": """\
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def launch_bad(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4, 4),
+                in_specs=[pl.BlockSpec((8, 8), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 8), lambda i, j: (i, j)),
+            )(x)
+
+        def helper_syncs(v, n):
+            if v > 0:
+                return v.item()
+            return n
+
+        def helper_clean(v, n):
+            if v is None:
+                return n
+            return v + n
+
+        @jax.jit
+        def traced(x):
+            a = helper_syncs(x, 3)
+            b = helper_clean(x, 4)
+            return a + b
+    """,
+}
+
+
+def test_collective_rules_positive_and_negative(tmp_path):
+    root = make_repo(tmp_path, COLLECTIVE_FIXTURE)
+    rep = run(root, analyzers=["collective"])
+    assert symbols(rep, "collective-mesh-axis") == {"model"}
+    assert symbols(rep, "collective-unknown-axis") == {"model"}
+    # dynamic axis names and param meshes are skipped, never guessed
+    assert not any(
+        "run_dynamic_axis" in f.message or "run_clean" in f.message
+        for f in rep.findings
+    )
+    arity = by_rule(rep, "collective-index-map-arity")
+    assert len(arity) == 1  # the 1-arg lambda; the 2-arg one is fine
+    assert "grid is rank 2" in arity[0].message
+    host = symbols(rep, "collective-host-in-callee")
+    # .item() and the value branch inside the callee, but NOT the
+    # `is None` identity check in helper_clean
+    assert any("helper_syncs" in s for s in host)
+    assert not any("helper_clean" in s for s in host)
+
+
+# -- races: explicit acquire()/release() --------------------------------------
+
+
+def test_races_acquire_release_pairs(tmp_path):
+    root = make_repo(tmp_path, {
+        "serving/explicit.py": """\
+            import threading
+
+            class Explicit:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    self._lock.acquire()
+                    try:
+                        self._n += 1
+                    finally:
+                        self._lock.release()
+
+                def read(self):
+                    with self._lock:
+                        return self._n
+
+            class Leaky:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    self._lock.acquire()
+                    self._lock.release()
+                    self._n += 1
+
+                def read(self):
+                    with self._lock:
+                        return self._n
+        """,
+    })
+    rep = run(root, analyzers=["races"])
+    rmw = symbols(rep, "race-unguarded-rmw")
+    # try/finally acquire() guards the write: clean
+    assert not any("Explicit" in s for s in rmw)
+    # a write AFTER release() is still unguarded: flagged
+    assert any("Leaky" in s for s in rmw)
+
+
+# -- baseline hygiene ---------------------------------------------------------
+
+
+def test_stale_baseline_entries_warn_not_drop(tmp_path):
+    root = make_repo(tmp_path, {"a.py": "import os\n"})
+    stale_keys = [
+        "hygiene-unused-import:a.py:os",        # live: resolves
+        "nope-rule:a.py:os",                    # unknown rule
+        "hygiene-unused-import:gone.py:os",     # missing file
+        "hygiene-unused-import:a.py:vanished",  # symbol gone
+    ]
+    base = os.path.join(root, BASELINE_NAME)
+    with open(base, "w") as f:
+        json.dump({"version": 1, "findings": stale_keys}, f)
+    rep = run(root, analyzers=["hygiene"])
+    assert rep.baselined == 1
+    stale = by_rule(rep, "baseline-stale")
+    assert {s.symbol for s in stale} == set(stale_keys[1:])
+    assert all(s.severity == "warning" for s in stale)
+
+
+def test_cli_prune_baseline(tmp_path, capsys):
+    from predictionio_tpu.tools.cli import main
+
+    root = make_repo(tmp_path, {"a.py": "import os\n"})
+    base = os.path.join(root, BASELINE_NAME)
+    with open(base, "w") as f:
+        json.dump({"version": 1, "findings": [
+            "hygiene-unused-import:a.py:os",
+            "nope-rule:a.py:os",
+        ]}, f)
+    assert main(["analyze", "--root", root, "--prune-baseline"]) == 0
+    out = capsys.readouterr().out
+    assert "nope-rule:a.py:os" in out and "1 stale entry pruned" in out
+    assert load_baseline(base) == {"hygiene-unused-import:a.py:os"}
+    # idempotent: nothing left to prune
+    assert main(["analyze", "--root", root, "--prune-baseline"]) == 0
+
+
+# -- SARIF --------------------------------------------------------------------
+
+
+def test_cli_analyze_sarif(tmp_path, capsys):
+    from predictionio_tpu.tools.cli import main
+
+    root = make_repo(tmp_path, {"a.py": "import os\n"})
+    code = main(["analyze", "--root", root, "--format", "sarif"])
+    d = json.loads(capsys.readouterr().out)
+    assert code == 1
+    assert d["version"] == "2.1.0"
+    run0 = d["runs"][0]
+    assert run0["tool"]["driver"]["name"] == "pio-analyze"
+    rule_ids = {r["id"] for r in run0["tool"]["driver"]["rules"]}
+    assert "hygiene-unused-import" in rule_ids
+    res = run0["results"][0]
+    assert res["ruleId"] == "hygiene-unused-import"
+    assert res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "a.py"
+    assert loc["region"]["startLine"] >= 1
+    assert res["partialFingerprints"]["pioKey"].startswith(
+        "hygiene-unused-import:a.py:"
+    )
+
+
+def test_report_by_analyzer_counts(tmp_path):
+    root = make_repo(tmp_path, {"a.py": "import os\n"})
+    d = run(root, analyzers=["hygiene"]).to_dict()
+    assert d["by_analyzer"]["hygiene"]["error"] == 1
+
+
 # -- the real checkout --------------------------------------------------------
 
 

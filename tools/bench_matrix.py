@@ -289,23 +289,40 @@ def main() -> int:
     # static-analysis gate: perf numbers from a repo carrying hot-path or
     # race hazards are not publishable — `pio analyze` must report zero
     # errors for the matrix to count
+    ana_t0 = time.monotonic()
     ana = subprocess.run(
         [sys.executable, "-m", "predictionio_tpu.tools.cli",
          "analyze", "--format", "json", "--root", REPO],
         cwd=REPO, capture_output=True, text=True,
     )
+    ana_wall = time.monotonic() - ana_t0
+    # the interprocedural engine must stay cheap enough to run in tier-1:
+    # a budget gate on wall time keeps it from quietly becoming unrunnable
+    ana_budget_s = 60.0
     try:
         report = json.loads(ana.stdout)
         counts = report.get("counts", {})
+        by_analyzer = report.get("by_analyzer") or {}
         artifact["analysis"] = {
             "errors": counts.get("error"),
             "warnings": counts.get("warning"),
             "baselined": report.get("baselined"),
-            "gate_pass": counts.get("error") == 0,
+            "errors_by_analyzer": {
+                name: sev.get("error", 0)
+                for name, sev in sorted(by_analyzer.items())
+            },
+            "callgraph": report.get("callgraph"),
+            "wall_s": round(ana_wall, 2),
+            "budget_s": ana_budget_s,
+            "gate_pass": (
+                counts.get("error") == 0 and ana_wall < ana_budget_s
+            ),
         }
     except (json.JSONDecodeError, AttributeError):
         artifact["analysis"] = {
             "errors": None, "warnings": None, "baselined": None,
+            "errors_by_analyzer": None, "callgraph": None,
+            "wall_s": round(ana_wall, 2), "budget_s": ana_budget_s,
             "gate_pass": False,
             "stderr": (ana.stderr or "")[-500:],
         }

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# CI / pre-commit static-analysis gate.
+#
+# Runs `pio analyze` scoped to the files changed vs HEAD (plus
+# untracked), emitting SARIF for code-scanning upload.  The exit code is
+# the gate: non-zero exactly when there are NEW errors — findings
+# already acknowledged in .pio-analysis-baseline.json never fail the
+# gate (they are counted, and the baseline diff is the regression
+# record).  See docs/analysis.md.
+#
+# Usage:
+#   tools/ci_analyze.sh [output.sarif]
+#
+# Environment:
+#   PIO_ANALYZE_FULL=1   analyze every file, not just the changed set
+#                        (what the nightly/full-CI lane runs)
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+SARIF_OUT="${1:-analysis.sarif}"
+SCOPE=(--changed-only)
+if [ "${PIO_ANALYZE_FULL:-0}" = "1" ]; then
+  SCOPE=()
+fi
+
+rc=0
+python -m predictionio_tpu.tools.cli analyze "${SCOPE[@]}" \
+  --format sarif >"$SARIF_OUT" || rc=$?
+
+# the human-readable echo of the same scope, for the CI log
+python -m predictionio_tpu.tools.cli analyze "${SCOPE[@]}" || true
+
+n_results=$(python - "$SARIF_OUT" <<'PY'
+import json, sys
+with open(sys.argv[1]) as f:
+    sarif = json.load(f)
+print(sum(len(r.get("results", [])) for r in sarif.get("runs", [])))
+PY
+)
+echo "[ci_analyze] ${n_results} finding(s) in scope -> ${SARIF_OUT}" >&2
+
+if [ "$rc" -ne 0 ]; then
+  echo "[ci_analyze] FAIL: new errors vs baseline (exit $rc)" >&2
+  echo "[ci_analyze] fix them, suppress with '# pio: ignore[rule]' +" \
+       "rationale, or acknowledge via --write-baseline" >&2
+fi
+exit "$rc"
