@@ -336,6 +336,13 @@ def _zipf_serving_phase(engine, storage, ctx, users) -> dict:
         metrics_live = any(
             n == "pio_result_cache_lookups_total" for (n, _) in series
         )
+        # which scan the cache-MISS path takes: pio_ivf_* families emit
+        # only while an IVF index is live, so presence IS the backend
+        ivf_live = any(n == "pio_ivf_info" for (n, _) in series)
+        scanned = [
+            v for (n, _), v in series.items()
+            if n == "pio_ivf_scanned_fraction"
+        ]
     finally:
         qs.stop()
 
@@ -367,7 +374,10 @@ def _zipf_serving_phase(engine, storage, ctx, users) -> dict:
         ),
         "errors": uni["errors"] + zipf["errors"],
         "metrics_live": metrics_live,
+        "retrieval_backend": "ivf" if ivf_live else "exact",
     }
+    if scanned:
+        out["ivf_scanned_fraction"] = max(scanned)
     hot = ((s2.get("fastpath") or [{}])[0] or {}).get("hotset")
     if hot:
         out["hotset"] = {
@@ -1826,6 +1836,107 @@ def _sharded_serving_bench(ctx) -> dict:
     }
 
 
+def _retrieval_bench(ctx, platform) -> dict:
+    """IVF retrieval gate (ISSUE 16): serve a clustered catalog through the
+    coarse-partition fast path at the DEFAULT nprobe and prove the two
+    halves of the trade hold at once — recall@10 >= 0.95 against the exact
+    scorer AND mean scanned fraction <= 0.2 of the catalog's padded rows.
+
+    The catalog is a Gaussian mixture, not white noise: IVF prunes
+    *structure*, and a structureless catalog has nothing to prune (every
+    cluster holds someone's top-k, so recall collapses at any scanned
+    fraction < 1).  Real item-factor matrices cluster — genre, popularity
+    band, co-consumption — and the mixture encodes that regime.
+
+    Recall is measured over b=1 dispatches, where the probe budget is the
+    per-query ``nprobe`` itself (no batch widening) — the same regime the
+    publish-time refusal gate measures.  The scanned fraction comes from
+    the scorer's own accounting (``stats()['retrieval']``), read BEFORE
+    any batched timing dispatches so wide rungs' widened probe budgets
+    don't dilute it.  Wall-clock scores/s is recorded on TPU only: off
+    TPU the fused kernel runs under the Pallas interpreter, whose timings
+    are meaningless.
+    """
+    from predictionio_tpu.core.evaluation import recall_at_k
+    from predictionio_tpu.ops import ivf as ivf_mod
+    from predictionio_tpu.serving.fastpath import BucketedScorer
+
+    n_items = int(os.environ.get("BENCH_IVF_ITEMS", 8192))
+    rank = int(os.environ.get("BENCH_IVF_RANK", 16))
+    nlist = int(os.environ.get("BENCH_IVF_NLIST", 64))
+    n_queries = int(os.environ.get("BENCH_IVF_QUERIES", 96))
+    k = 10
+    rng = np.random.default_rng(16)
+    centers = (rng.normal(size=(nlist, rank)) * 4.0).astype(np.float32)
+    item_cluster = rng.integers(0, nlist, size=n_items)
+    V = (
+        centers[item_cluster] + rng.normal(size=(n_items, rank)) * 0.25
+    ).astype(np.float32)
+    # queries live near the same centers: each user's top-k concentrates
+    # in a handful of clusters, the regime the nprobe default targets
+    q_cluster = rng.integers(0, nlist, size=n_queries)
+    U = (
+        centers[q_cluster] + rng.normal(size=(n_queries, rank)) * 0.25
+    ).astype(np.float32)
+
+    index = ivf_mod.build_index(V, nlist)  # default nprobe = nlist // 8
+    exact_sc = BucketedScorer(ctx, U, V, max_k=k)
+    ivf_sc = BucketedScorer(
+        ctx, U, V, max_k=k, ivf_index=index, retrieval="ivf"
+    )
+    exact_rows = []
+    approx_rows = []
+    for u in range(n_queries):
+        one = np.array([u])
+        exact_rows.append(exact_sc.score_topk(one, k)[0][0])
+        approx_rows.append(ivf_sc.score_topk(one, k)[0][0])
+    recall = recall_at_k(np.stack(exact_rows), np.stack(approx_rows), k)
+    st = (ivf_sc.stats() or {}).get("retrieval") or {}
+    frac = st.get("scanned_fraction")
+
+    measured = None
+    if platform == "tpu":  # never time the Pallas interpreter
+        users_all = np.arange(n_queries)
+        exact_sc.score_topk(users_all, k)  # warm the wide rung
+        ivf_sc.score_topk(users_all, k)
+        reps = int(os.environ.get("BENCH_IVF_REPS", 20))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            exact_sc.score_topk(users_all, k)
+        t_exact = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            ivf_sc.score_topk(users_all, k)
+        t_ivf = time.perf_counter() - t0
+        scored = reps * n_queries * n_items
+        measured = {
+            "reps": reps,
+            "exact_scores_per_s": round(scored / t_exact, 1),
+            "ivf_requests_per_s": round(reps * n_queries / t_ivf, 1),
+            "speedup_vs_exact": round(t_exact / t_ivf, 4),
+        }
+    return {
+        "n_items": n_items,
+        "rank": rank,
+        "k": k,
+        "queries": n_queries,
+        "nlist": int(st.get("nlist") or index.nlist),
+        "nprobe": int(st.get("nprobe") or index.nprobe),
+        "min_probes": st.get("min_probes"),
+        "cap_pad": st.get("cap_pad"),
+        "recall_at_10": round(float(recall), 4),
+        "scanned_fraction": frac,
+        "analytic_scan_speedup": (
+            round(1.0 / frac, 2) if frac else None
+        ),
+        "fingerprint": st.get("fingerprint"),
+        "measured": measured,
+        "gate_pass": bool(
+            recall >= 0.95 and frac is not None and frac <= 0.2
+        ),
+    }
+
+
 def main() -> None:
     # BENCH_PLATFORM=cpu skips the (slow) tunnel probe for local iteration
     forced_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
@@ -2048,6 +2159,14 @@ def main() -> None:
                   file=sys.stderr)
             sharded = {"error": str(e)}
         print(f"INFO: sharded_serving: {sharded}", file=sys.stderr)
+    retrieval = None
+    if os.environ.get("BENCH_RETRIEVAL", "1") != "0":
+        try:
+            retrieval = _retrieval_bench(ctx, platform)
+        except Exception as e:  # the IVF gate must never kill the artifact
+            print(f"WARNING: retrieval bench failed: {e}", file=sys.stderr)
+            retrieval = {"error": str(e)}
+        print(f"INFO: retrieval: {retrieval}", file=sys.stderr)
     record = {
         "metric": "als_train_events_per_sec_per_chip",
         "value": round(value, 1),
@@ -2094,6 +2213,8 @@ def main() -> None:
         record["elastic"] = elastic
     if sharded is not None:
         record["multichip"] = {"sharded_serving": sharded}
+    if retrieval is not None:
+        record["retrieval"] = retrieval
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

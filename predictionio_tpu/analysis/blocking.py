@@ -1,8 +1,9 @@
 """Blocking-call detector for the serving dispatch hot loop.
 
 The micro-batcher worker (``serving/batching.py``), the fastpath
-scorer (``serving/fastpath.py``), and the shard fan-out/merge layer
-(``serving/sharding.py``) sit between every query and the TPU: one
+scorer (``serving/fastpath.py``), the shard fan-out/merge layer
+(``serving/sharding.py``), and the IVF probe-selection/pruned-scan
+helpers (``ops/ivf.py``) sit between every query and the TPU: one
 ``time.sleep``, ``fsync``, JSON round-trip, or synchronous network
 call there is paid by the whole batch at p50, not by one request at
 p99.  Serialization belongs at the HTTP layer, durability in the WAL's
@@ -14,7 +15,8 @@ teardown (``__init__``/``_compile``/``stats``/``stop``/``close``) and
 the publish-time plan builders (``build_plan``/``save_plan``/
 ``load_plan``/``plan_from_env``/``build_layout``/``to_payload``/
 ``from_payload``/``describe`` — they run at train/rebalance time, never
-under a dispatch, and the sealed-blob write MUST fsync), plus
+under a dispatch, and the sealed-blob write MUST fsync; the same goes
+for ``ops/ivf.py``'s k-means/recall-gate/blob machinery), plus
 worker-loop functions (``_loop``/``_run``/``_flush``/``_drain``/
 ``_health_loop``/``_monitor_loop``/``_control_loop`` — the last three
 are the fleet router's health prober, the fleet supervisor's child
@@ -42,6 +44,9 @@ R_BLOCKING = rule(
 
 # dispatch modules: every function is hot unless exempted
 _HOT_MODULES = ("batching.py", "fastpath.py", "sharding.py")
+# ops modules on the serving dispatch path: probe selection and the
+# pruned scan in ivf.py run under every cache-miss query
+_HOT_OPS_MODULES = ("ivf.py",)
 _EXEMPT_FUNCS = {"__init__", "_compile", "stats", "stop", "close",
                  "__repr__",
                  # sharding.py publish/rebalance-time plan machinery:
@@ -49,8 +54,15 @@ _EXEMPT_FUNCS = {"__init__", "_compile", "stats", "stop", "close",
                  # under a dispatch (ShardAccounting.note/snapshot and
                  # ShardLayout.take_rows stay in scope)
                  "build_plan", "save_plan", "load_plan", "plan_from_env",
+                 "plan_from_assignment",
                  "build_layout", "to_payload", "from_payload",
-                 "describe", "validate", "shard_count_for_budget"}
+                 "describe", "validate", "shard_count_for_budget",
+                 # ivf.py publish/rebuild-time machinery: k-means, the
+                 # recall gate and the sealed-blob envelope run at train
+                 # or `pio ivf rebuild` time, never under a dispatch
+                 # (resolve_retrieval/default_nprobe stay in scope)
+                 "train_kmeans", "build_index", "index_from_env",
+                 "measure_recall", "save_index", "load_index"}
 # worker-loop functions checked across the wider threaded scope
 # (_health_loop/_monitor_loop/_control_loop: the router's probe pacer,
 # the fleet supervisor's child watcher, and the autoscaler's decision
@@ -93,6 +105,8 @@ def _hot_functions(mod: Module):
     base = mod.rel.rsplit("/", 1)[-1]
     hot_module = (
         rel_in(mod.rel, "serving") and base in _HOT_MODULES
+    ) or (
+        rel_in(mod.rel, "ops") and base in _HOT_OPS_MODULES
     )
     # wal.py is exempt: its group-commit thread exists to fsync
     in_threaded_scope = (

@@ -77,7 +77,10 @@ _WARMUP_PREFIXES = ("warm", "_warm", "build", "_build", "make", "_make",
 # and offline-analytics functions (train_*, cross_occurrence_*) compile
 # lazily by design and are out of scope.
 _REQUEST_PREFIXES = ("recommend", "score", "predict", "query", "handle",
-                     "serve", "submit", "dispatch", "lookup", "rank")
+                     "serve", "submit", "dispatch", "lookup", "rank",
+                     # IVF retrieval: probe selection and the pruned
+                     # scan run per cache-miss query
+                     "retrieve", "probe")
 
 
 def _is_request_path(names: list[str]) -> bool:

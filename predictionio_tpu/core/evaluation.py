@@ -79,6 +79,45 @@ def quantized_topk_overlap(
     return float(np.mean(overlaps))
 
 
+def recall_at_k(exact_idx, approx_idx, k: int) -> float:
+    """Mean recall@k of an approximate ranking vs the exact one.
+
+    ``exact_idx``/``approx_idx`` are ``(rows, ≥k)`` integer id matrices —
+    per-row top-k item ids from the exact scorer and from an approximate
+    retrieval path (IVF, ``ops/ivf.py``).  Per row, recall is
+    ``|exact[:k] ∩ approx[:k]| / min(k, real exact ids)``: padding slots
+    (negative ids, or the ``PAD_SENTINEL`` used for padded leaderboard
+    slots) are excluded from BOTH sides, and the denominator shrinks with
+    them, so a row with fewer than ``k`` real candidates is scored
+    against what an exact ranker could actually return rather than
+    penalized for ids that do not exist.  Set intersection makes the
+    metric tie-order independent: any exact top-k among tied scores
+    counts the same.  This is the ``PIO_IVF_MIN_RECALL`` publish-gate
+    metric, parallel to :func:`quantized_topk_overlap` for quantization.
+    """
+    import numpy as np
+
+    from predictionio_tpu.serving.sharding import PAD_SENTINEL
+
+    exact = np.atleast_2d(np.asarray(exact_idx, np.int64))[:, :k]
+    approx = np.atleast_2d(np.asarray(approx_idx, np.int64))[:, :k]
+    if exact.shape[0] != approx.shape[0]:
+        raise ValueError(
+            f"row mismatch: exact has {exact.shape[0]}, "
+            f"approx has {approx.shape[0]}"
+        )
+    recalls = []
+    for e_row, a_row in zip(exact, approx):
+        e = np.unique(e_row[(e_row >= 0) & (e_row < int(PAD_SENTINEL))])
+        a = np.unique(a_row[(a_row >= 0) & (a_row < int(PAD_SENTINEL))])
+        denom = min(int(k), len(e))
+        if denom == 0:
+            recalls.append(1.0)  # nothing retrievable ⇒ nothing missed
+            continue
+        recalls.append(len(np.intersect1d(e, a, assume_unique=True)) / denom)
+    return float(np.mean(recalls))
+
+
 class EngineParamsGenerator:
     """Parity: EngineParamsGenerator.scala:30."""
 

@@ -151,6 +151,10 @@ class ALSModel:
     # replicated. Travels inside the sealed MODELDATA pickle (auto mode)
     # or as its own sealed plan.blob (checkpoint mode).
     sharding_plan: Optional[object] = None
+    # publish-time IVF coarse-retrieval index (ops/ivf.py), declared when
+    # PIO_IVF_NLIST asks for an approximate scan; None serves exact.
+    # Recall-gated at publish and sealed as ivf.blob (checkpoint mode).
+    ivf_index: Optional[object] = None
 
     def predict_rating(self, user_idx: int, item_idx: int) -> float:
         return float(self.user_factors[user_idx] @ self.item_factors[item_idx])
@@ -970,13 +974,13 @@ def train_als(
     # return in original id order so the model is permutation-invisible
     U_host = U_all[u_perm[:n_users]] if u_perm is not None else U_all[:n_users]
     V_host = V_all[i_perm[:n_items]] if i_perm is not None else V_all[:n_items]
-    return _declare_sharding_plan(ALSModel(
+    return _declare_ivf_partition(_declare_sharding_plan(ALSModel(
         user_factors=U_host,
         item_factors=V_host,
         user_map=interactions.user_map,
         item_map=interactions.item_map,
         config=cfg,
-    ))
+    )))
 
 
 def _dense_blocks_for(interactions, cfg: ALSConfig, n_shards: int):
@@ -1248,13 +1252,13 @@ def _train_als_sharded(ctx: MeshContext, sh, cfg: ALSConfig) -> ALSModel:
         # its exchange long ago, so the rendezvous blobs can go
         sh.cleanup()
     n_users, n_items = sh.n_users, sh.n_items
-    return _declare_sharding_plan(ALSModel(
+    return _declare_ivf_partition(_declare_sharding_plan(ALSModel(
         user_factors=U_all[u_perm[:n_users]],
         item_factors=V_all[i_perm[:n_items]],
         user_map=sh.user_map,
         item_map=sh.item_map,
         config=cfg,
-    ))
+    )))
 
 
 def _declare_sharding_plan(model: ALSModel) -> ALSModel:
@@ -1283,6 +1287,34 @@ def _declare_sharding_plan(model: ALSModel) -> ALSModel:
         logger.info(
             "declared sharding plan %s: %d shards (%s)",
             plan.fingerprint, plan.n_shards, plan.strategy,
+        )
+    return model
+
+
+def _declare_ivf_partition(model: ALSModel) -> ALSModel:
+    """Publish-time IVF declaration (PIO_IVF_NLIST knob; no-op unset).
+
+    Trains the k-means coarse partition over the item factors
+    (``ops/ivf.py``) and attaches it to the model; the recall gate runs
+    at publish (``CheckpointedALSModel._publish_ivf``), not here —
+    training declares the intent, publish audits it.  Any declaration
+    failure publishes exact-only with a warning: the approximate path is
+    an optimization, never a point of failure.
+    """
+    from predictionio_tpu.ops import ivf as _ivf
+
+    try:
+        index = _ivf.index_from_env(model.item_factors)
+    except ValueError as e:
+        logger.warning(
+            "IVF index declaration failed (%s); publishing exact-only", e
+        )
+        return model
+    if index is not None:
+        model.ivf_index = index
+        logger.info(
+            "declared IVF index %s: nlist=%d nprobe=%d",
+            index.fingerprint, index.nlist, index.nprobe,
         )
     return model
 
@@ -1325,11 +1357,12 @@ class CheckpointedALSModel(ALSModel):
         if distributed.should_write_storage():
             quant_meta = self._publish_quantized(d)
             shard_meta = self._publish_plan(d)
+            ivf_meta = self._publish_ivf(d)
             with open(os.path.join(d, "maps.pkl"), "wb") as f:
                 pickle.dump(
                     {"user_map": self.user_map, "item_map": self.item_map,
                      "config": self.config, "quant": quant_meta,
-                     "sharding": shard_meta},
+                     "sharding": shard_meta, "ivf": ivf_meta},
                     f,
                 )
         return True  # manifest mode: MODELDATA stores only the class path
@@ -1424,6 +1457,71 @@ class CheckpointedALSModel(ALSModel):
             "threshold": threshold, "k": k,
         }
 
+    def _publish_ivf(self, d: str) -> dict:
+        """Recall-gate and seal the IVF index at model publish (ivf.blob).
+
+        Measures recall@10 of the IVF-pruned ranking vs the exact one
+        (:func:`ops.ivf.measure_recall`, fp32 factors, b=1 probing) and
+        only if it clears ``PIO_IVF_MIN_RECALL`` seals the index through
+        the persistence checksum envelope — exactly the
+        ``PIO_QUANT_MIN_OVERLAP`` contract for quantization.  A refused
+        index leaves no blob and serving stays exact; the manifest record
+        is always written, so the refusal and its measured recall are
+        auditable.  Models built without :func:`train_als` (tests, bulk
+        imports) can still declare via ``PIO_IVF_NLIST`` here.
+        """
+        import os
+
+        from predictionio_tpu.ops import ivf as _ivf
+
+        index = getattr(self, "ivf_index", None)
+        if index is None:
+            try:
+                index = _ivf.index_from_env(self.item_factors)
+            except ValueError as e:
+                logger.warning(
+                    "IVF index declaration failed (%s); publishing "
+                    "exact-only", e,
+                )
+                return {"nlist": 0}
+        if index is None:
+            return {"nlist": 0}
+        k = min(10, self.item_factors.shape[0])
+        threshold = float(os.environ.get("PIO_IVF_MIN_RECALL", "0.95"))
+        sample = int(os.environ.get("PIO_IVF_EVAL_USERS", "256") or 256)
+        recall = _ivf.measure_recall(
+            self.user_factors, self.item_factors, index,
+            k=k, sample=sample,
+        )
+        if recall < threshold:
+            logger.warning(
+                "IVF publish REFUSED: recall@%d %.4f < %.4f "
+                "(PIO_IVF_MIN_RECALL); serving stays exact",
+                k, recall, threshold,
+            )
+            self.ivf_index = None
+            return {
+                "nlist": 0, "refused": index.nlist,
+                "recall": recall, "threshold": threshold, "k": k,
+            }
+        index = dataclasses.replace(
+            index, recall_at_publish=recall,
+            recall_threshold=threshold, recall_k=k,
+        )
+        self.ivf_index = index
+        _ivf.save_index(os.path.join(d, "ivf.blob"), index)
+        logger.info(
+            "IVF index sealed: nlist=%d nprobe=%d recall@%d %.4f >= %.4f, "
+            "fingerprint %s",
+            index.nlist, index.nprobe, k, recall, threshold,
+            index.fingerprint,
+        )
+        return {
+            "nlist": index.nlist, "nprobe": index.nprobe,
+            "recall": recall, "threshold": threshold, "k": k,
+            "fingerprint": index.fingerprint,
+        }
+
     @classmethod
     def load(cls, instance_id: str, params, ctx) -> "CheckpointedALSModel":
         import os
@@ -1444,7 +1542,57 @@ class CheckpointedALSModel(ALSModel):
         )
         cls._load_quantized(model, d, meta.get("quant") or {})
         cls._load_plan(model, d, meta.get("sharding") or {})
+        cls._load_ivf(model, d, meta.get("ivf") or {})
         return model
+
+    @staticmethod
+    def _load_ivf(model: "CheckpointedALSModel", d: str, rec: dict) -> None:
+        """Attach the published IVF index, degrading on any damage.
+
+        A torn/missing ivf.blob, a checksum mismatch, or a fingerprint
+        that disagrees with the manifest all log a warning and leave
+        ``ivf_index`` unset — the server cold-starts on the exact scan
+        (``PIO_RETRIEVAL=auto`` resolves to exact; the deploy never
+        fails).  ``PIO_RETRIEVAL=exact`` is the operator rollback: the
+        sealed index is ignored even though present and valid.
+        """
+        import os
+        import pickle
+
+        from predictionio_tpu.core import persistence as _persistence
+        from predictionio_tpu.ops import ivf as _ivf
+
+        if not rec or not rec.get("nlist"):
+            return
+        want = (os.environ.get("PIO_RETRIEVAL") or "auto").strip().lower()
+        if want == "exact":
+            logger.info(
+                "PIO_RETRIEVAL=exact: ignoring sealed IVF index; "
+                "serving exact"
+            )
+            return
+        try:
+            index = _ivf.load_index(os.path.join(d, "ivf.blob"))
+            want_fp = rec.get("fingerprint")
+            if want_fp and index.fingerprint != want_fp:
+                raise _persistence.ModelIntegrityError(
+                    f"IVF fingerprint {index.fingerprint} != manifest "
+                    f"{want_fp}"
+                )
+            model.ivf_index = index
+            logger.info(
+                "loaded IVF index %s: nlist=%d nprobe=%d (recall@%s %.4f "
+                "at publish)",
+                index.fingerprint, index.nlist, index.nprobe,
+                rec.get("k"), rec.get("recall", -1.0),
+            )
+        except (
+            _persistence.ModelIntegrityError, OSError, KeyError,
+            pickle.UnpicklingError, EOFError, ValueError,
+        ) as e:
+            logger.warning(
+                "IVF index unavailable (%s); serving exact", e
+            )
 
     @staticmethod
     def _load_plan(model: "CheckpointedALSModel", d: str, rec: dict) -> None:
@@ -1643,8 +1791,11 @@ class ALSScorer:
                     m = self.model
                     dtype = getattr(m, "factor_dtype", "f32")
                     # publish-time ShardingPlan (if declared) selects the
-                    # sharded factor placement per PIO_SERVING_SHARDING
+                    # sharded factor placement per PIO_SERVING_SHARDING;
+                    # a published IVF index likewise selects the pruned
+                    # retrieval path per PIO_RETRIEVAL
                     plan = getattr(m, "sharding_plan", None)
+                    ivf_index = getattr(m, "ivf_index", None)
                     if dtype != "f32" and m.user_factors_q is not None:
                         # published quantized variant: device-resident
                         # narrow factors, dequantized in-kernel
@@ -1657,6 +1808,7 @@ class ALSScorer:
                             user_scale=m.user_scale,
                             item_scale=m.item_scale,
                             plan=plan,
+                            ivf_index=ivf_index,
                         )
                     else:
                         fp = BucketedScorer(
@@ -1665,6 +1817,7 @@ class ALSScorer:
                             m.item_factors,
                             max_k=max_k or self.max_k,
                             plan=plan,
+                            ivf_index=ivf_index,
                         )
                     self._fastpath = fp
         return fp

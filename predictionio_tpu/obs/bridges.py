@@ -405,6 +405,72 @@ def bridge_sharding(
     registry.register_collector(collect)
 
 
+def bridge_ivf(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """IVF retrieval accounting → pio_ivf_* series.
+
+    Emits nothing while the scorer serves the exact scan (no
+    ``retrieval`` block in its stats), so the family set appears exactly
+    when an IVF index is live — the same presence contract as
+    ``pio_shard_*``.  ``pio_ivf_scanned_fraction`` is the realized
+    HBM-bytes ratio of the probe scans vs the exact full scans the same
+    dispatches would have run; the bench gates it at ≤ 0.2.
+    """
+
+    def collect():
+        s = stats_fn()
+        rv = (s or {}).get("retrieval")
+        if not isinstance(rv, dict):
+            return []
+        fams = [
+            _fam(
+                "pio_ivf_info", "gauge",
+                "Active IVF index (info gauge; value is the cluster "
+                "count, labels carry the index identity).",
+                [(
+                    "",
+                    (("fingerprint", str(rv.get("fingerprint", ""))),),
+                    _num(rv.get("nlist")),
+                )],
+            ),
+            _fam(
+                "pio_ivf_nprobe", "gauge",
+                "Serving-time probe budget per query (PIO_IVF_NPROBE "
+                "override, else the publish-time default).",
+                [("", (), _num(rv.get("nprobe")))],
+            ),
+            _fam(
+                "pio_ivf_probed_blocks_total", "counter",
+                "Cluster blocks scanned across all dispatches (rung "
+                "probe budgets summed).",
+                [("", (), _num(rv.get("probed_blocks")))],
+            ),
+            _fam(
+                "pio_ivf_scanned_fraction", "gauge",
+                "Realized scan-bytes fraction vs the exact path for the "
+                "same dispatches (probe rows / full-catalog rows).",
+                [("", (), _num(rv.get("scanned_fraction")))],
+            ),
+            _fam(
+                "pio_ivf_recall_at_publish", "gauge",
+                "Recall@k the sealed index measured at its publish gate "
+                "(PIO_IVF_MIN_RECALL receipt).",
+                [("", (), _num(rv.get("recall_at_publish")))],
+            ),
+            _fam(
+                "pio_ivf_resident_extra_bytes", "gauge",
+                "Device-resident bytes the IVF layout adds over the "
+                "replicated exact placement (centroids, id map, pad "
+                "mask).",
+                [("", (), _num(rv.get("resident_extra_bytes")))],
+            ),
+        ]
+        return fams
+
+    registry.register_collector(collect)
+
+
 # -- serving: device-utilization accountant ----------------------------------
 
 def bridge_devprof(

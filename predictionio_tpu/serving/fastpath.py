@@ -35,6 +35,24 @@ traffic is the (B,) user-index upload and the (B, k) result readback.
   declares a plan AND the mesh has the devices for it, so every existing
   caller keeps replicated behavior unchanged.
 
+RETRIEVAL (``PIO_RETRIEVAL=exact|ivf|auto``, default ``auto``): with an
+:class:`~predictionio_tpu.ops.ivf.IVFIndex` declared at publish, the
+replicated placement can serve the IVF-pruned scan instead of the full
+one — the compiled program scores the batch against the ``nlist``
+centroids, picks a probe set of clusters, and runs the SAME fused
+``gather_score_topk`` over only those clusters' contiguous blocks (laid
+out by ``build_layout`` exactly like shard blocks), merging per-probe
+leaderboards with ``merge_topk``.  Because per-cluster blocks are
+ascending by global id, probing EVERY cluster (``nprobe == nlist``)
+returns answers bit-identical to the exact path — the same tie-order
+proof as the sharded merge.  The probe budget scales with the rung —
+``P_b = clamp(nprobe·b, min_probes, nlist)`` — so the per-query
+amortized scanned fraction stays ≈ ``nprobe/nlist`` at every batch size
+while the probed set covers each row's union of likely clusters
+(``min_probes`` guarantees the probed clusters always hold ≥ k real
+items).  IVF composes with the replicated placement only; a sharded plan
+takes precedence and retrieval degrades to exact with a warning.
+
 HOT-SET PATH (``PIO_HOTSET_SIZE``, off by default): ALS scores are static
 between reloads — a hot user's top-k is the SAME answer every time until
 the next generation deploys.  The scorer keeps decayed per-user request
@@ -60,6 +78,7 @@ import numpy as np
 
 from predictionio_tpu.obs import devprof as _devprof
 from predictionio_tpu.obs import tracing as _tracing
+from predictionio_tpu.ops import ivf as _ivf
 from predictionio_tpu.ops import score_kernel as _score_kernel
 from predictionio_tpu.ops.topk import (
     gather_score_topk, merge_topk, resolve_backend,
@@ -155,6 +174,8 @@ class BucketedScorer:
         backend: Optional[str] = None,
         plan=None,
         sharding: Optional[str] = None,
+        ivf_index=None,
+        retrieval: Optional[str] = None,
     ):
         self.ctx = ctx
         self.n_users = user_factors.shape[0]
@@ -172,6 +193,19 @@ class BucketedScorer:
         self.plan = plan
         self.sharding = resolve_serving_backend(sharding, plan=plan, ctx=ctx)
         self._shard_acct: Optional[_sharding.ShardAccounting] = None
+        # retrieval path (PIO_RETRIEVAL): IVF prunes the replicated scan;
+        # it composes with the replicated placement only — a sharded plan
+        # already partitions the scan across devices, and stacking the two
+        # layouts would shard cluster blocks mid-block
+        self.ivf_index = ivf_index
+        retr = _ivf.resolve_retrieval(retrieval, index=ivf_index)
+        if retr == "ivf" and self.sharding == "sharded":
+            logger.warning(
+                "IVF retrieval composes with replicated placement only; "
+                "the sharding plan takes precedence — serving exact sharded"
+            )
+            retr = "exact"
+        self.retrieval = retr
         if factor_dtype == "f32":
             user_factors = np.asarray(user_factors, np.float32)
             item_factors = np.asarray(item_factors, np.float32)
@@ -182,6 +216,10 @@ class BucketedScorer:
             self._shard_acct = _sharding.ShardAccounting(
                 self.plan, self._local_k
             )
+        elif self.retrieval == "ivf":
+            self._init_ivf_placement(
+                user_factors, item_factors, user_scale, item_scale
+            )
         else:
             self._init_replicated_placement(
                 user_factors, item_factors, user_scale, item_scale
@@ -190,7 +228,14 @@ class BucketedScorer:
             int(a.nbytes)
             for a in (self._U, self._V, self._Uscale, self._Vscale)
             if a is not None
-        )
+        ) + getattr(self, "_ivf_extra_bytes", 0)
+        # IVF scan accounting (guarded by self._lock with the other
+        # counters): probed blocks and scanned padded rows per dispatch,
+        # against the exact path's would-have-scanned rows
+        self._ivf_dispatches = 0
+        self._ivf_probed_blocks = 0
+        self._ivf_scanned_rows = 0
+        self._ivf_dispatch_rows = 0
         self._lock = threading.Lock()
         self.compile_count = 0
         self.hits: dict[int, int] = {b: 0 for b in self.buckets}
@@ -278,6 +323,92 @@ class BucketedScorer:
         else:
             self._static_args = (self._U, self._V, self._item_pad_mask)
 
+    def _init_ivf_placement(
+        self, user_factors, item_factors, user_scale, item_scale
+    ) -> None:
+        """Replicated factors in IVF cluster-block layout + centroids.
+
+        The item matrix is permuted into the index's cluster blocks via
+        the SAME ``build_layout`` the sharded path uses — every cluster
+        a contiguous kernel-aligned block of ``cap_pad`` rows, real slots
+        ascending by global id (the tie-order invariant), global ids and
+        a pad mask riding alongside flat.  The compiled program slices
+        probe blocks out of this one replicated array, so compared to the
+        exact replicated placement the only extra residency is the
+        centroid matrix, the id/pad maps, and the per-cluster padding.
+        ``_n_items_pad`` becomes the PER-PROBE block size; the dispatch
+        cost annotation multiplies it by the rung's probe budget.
+        """
+        ctx = self.ctx
+        index = self.ivf_index
+        index.validate(self.n_items)
+        plan = index.plan
+        if self.backend == "fused":
+            pad_to = _score_kernel.pad_block_items
+        else:
+            def pad_to(n):
+                return pad_to_multiple(n, 8)
+        layout = _sharding.build_layout(plan, pad_to)
+        # written once here (an __init__ helper, before the scorer is
+        # shared) and never rebound after
+        self._ivf_layout = layout  # pio: ignore[race-unguarded-rebind]
+        self._n_items_pad = layout.cap_pad
+        # what the exact path would have scanned per row — the
+        # scanned-fraction denominator
+        self._exact_items_pad = int(pad_to(self.n_items))
+        self._local_k = min(self.k, layout.cap_pad)
+        # deploy-time probe budget: PIO_IVF_NPROBE overrides the
+        # publish-time default, clamped to [1, nlist]
+        env_nprobe = os.environ.get("PIO_IVF_NPROBE", "")
+        nprobe = (
+            int(env_nprobe) if env_nprobe.strip() else int(index.nprobe)
+        )
+        self._nprobe = max(1, min(nprobe, index.nlist))
+        # smallest probe count whose clusters are GUARANTEED to hold >= k
+        # real items (sum of the P smallest cluster sizes >= k), so padded
+        # slots can never win a final leaderboard slot
+        sizes = np.sort(plan.shard_sizes())
+        self._min_probes = int(
+            np.searchsorted(np.cumsum(sizes), self.k) + 1
+        )
+        self._probes = {  # pio: ignore[race-unguarded-rebind]
+            b: min(
+                index.nlist, max(self._min_probes, self._nprobe * b)
+            )
+            for b in self.buckets
+        }
+        self._repl = ctx.replicated()
+        self._U = ctx.replicate(np.asarray(user_factors))
+        self._V = ctx.replicate(
+            layout.take_rows(np.asarray(item_factors))
+        )
+        C = np.asarray(index.centroids, np.float32)
+        self._C = ctx.replicate(C)
+        gid = layout.gid
+        pad_mask = layout.pad_mask
+        self._ivf_gid = ctx.replicate(gid)
+        self._item_pad_mask = ctx.replicate(pad_mask)
+        if self.factor_dtype == "int8":
+            self._Uscale = ctx.replicate(np.asarray(user_scale, np.float32))
+            self._Vscale = ctx.replicate(
+                layout.take_rows(
+                    np.asarray(item_scale, np.float32), fill=1.0
+                )
+            )
+            self._static_args = (
+                self._U, self._V, self._Uscale, self._Vscale,
+                self._C, self._ivf_gid, self._item_pad_mask,
+            )
+        else:
+            self._Uscale = self._Vscale = None
+            self._static_args = (
+                self._U, self._V, self._C, self._ivf_gid,
+                self._item_pad_mask,
+            )
+        self._ivf_extra_bytes = (
+            int(C.nbytes) + int(gid.nbytes) + int(pad_mask.nbytes)
+        )
+
     def _init_sharded_placement(
         self, user_factors, item_factors, user_scale, item_scale
     ) -> None:
@@ -355,6 +486,8 @@ class BucketedScorer:
         """Lower + compile the bucket-b program ahead of time."""
         if self.sharding == "sharded":
             return self._compile_sharded(b)
+        if self.retrieval == "ivf":
+            return self._compile_ivf(b)
         k = self.k
         be = self.backend
 
@@ -381,6 +514,105 @@ class BucketedScorer:
         )
         self.compile_count += 1
         self._annotate_cost(b, compiled)
+        return compiled
+
+    def _compile_ivf(self, b: int):
+        """AOT-compile the bucket-b IVF probe → scan → merge program.
+
+        One program per rung, same ladder/warmup contract as the other
+        placements.  The batch's dequantized query rows score against the
+        centroids; the rung's probe budget ``P_b`` of clusters is picked
+        by ``lax.top_k`` over the row-wise MAX of centroid scores (at
+        b=1 this is exactly per-query nprobe selection — the publish
+        gate's measurement; at larger rungs the shared budget scales as
+        ``nprobe·b`` so per-query amortized scan stays ≈ nprobe/nlist).
+        A ``lax.scan`` over the probe ids dynamic-slices each cluster's
+        contiguous block out of the layout arrays and runs the EXISTING
+        ``gather_score_topk`` over it — per-probe leaderboards carry
+        global ids, and ``merge_topk``'s (value desc, id asc) order makes
+        the result bit-identical to the exact path when every cluster is
+        probed.  Only the probe blocks are ever touched: the scan cost
+        per dispatch is ``P_b·cap_pad`` rows instead of the full catalog.
+        """
+        import jax.numpy as jnp
+
+        k = self.k
+        lk = self._local_k
+        be = self.backend
+        cap = self._ivf_layout.cap_pad
+        P_b = self._probes[b]
+
+        if self.factor_dtype == "int8":
+
+            def fn(U, V, u_scale, v_scale, C, gid, pad_mask, u_idx):
+                q = U[u_idx].astype(jnp.float32) * u_scale[u_idx]
+                agg = jnp.max(q @ C.T, axis=0)  # (nlist,)
+                _, probes = jax.lax.top_k(agg, P_b)
+
+                def step(carry, p):
+                    s = p * cap
+                    Vb = jax.lax.dynamic_slice_in_dim(V, s, cap, 0)
+                    vsb = jax.lax.dynamic_slice_in_dim(v_scale, s, cap, 0)
+                    gb = jax.lax.dynamic_slice_in_dim(gid, s, cap, 0)
+                    mb = jax.lax.dynamic_slice_in_dim(pad_mask, s, cap, 0)
+                    vals, idx = gather_score_topk(
+                        U, Vb, u_idx, lk, item_mask=mb,
+                        u_scale=u_scale, v_scale=vsb, backend=be,
+                    )
+                    return carry, (vals, jnp.take(gb, idx))
+
+                _, (pv, pg) = jax.lax.scan(step, None, probes)
+                cand_v = jnp.swapaxes(pv, 0, 1).reshape(b, P_b * lk)
+                cand_g = jnp.swapaxes(pg, 0, 1).reshape(b, P_b * lk)
+                return merge_topk(cand_v, cand_g, k)
+
+        else:
+
+            def fn(U, V, C, gid, pad_mask, u_idx):
+                q = U[u_idx].astype(jnp.float32)
+                agg = jnp.max(q @ C.T, axis=0)  # (nlist,)
+                _, probes = jax.lax.top_k(agg, P_b)
+
+                def step(carry, p):
+                    s = p * cap
+                    Vb = jax.lax.dynamic_slice_in_dim(V, s, cap, 0)
+                    gb = jax.lax.dynamic_slice_in_dim(gid, s, cap, 0)
+                    mb = jax.lax.dynamic_slice_in_dim(pad_mask, s, cap, 0)
+                    vals, idx = gather_score_topk(
+                        U, Vb, u_idx, lk, item_mask=mb, backend=be
+                    )
+                    return carry, (vals, jnp.take(gb, idx))
+
+                _, (pv, pg) = jax.lax.scan(step, None, probes)
+                cand_v = jnp.swapaxes(pv, 0, 1).reshape(b, P_b * lk)
+                cand_g = jnp.swapaxes(pg, 0, 1).reshape(b, P_b * lk)
+                return merge_topk(cand_v, cand_g, k)
+
+        dummy_idx = jax.device_put(np.zeros(b, np.int32), self._repl)
+        compiled = (
+            jax.jit(fn)
+            .lower(*self._static_args, dummy_idx)
+            .compile()
+        )
+        self.compile_count += 1
+        # always the analytic model: the probe scan's Pallas calls are
+        # opaque to XLA cost analysis, and the analytic scanned-rows
+        # number (P_b·cap_pad, not the full catalog) IS the story
+        rank = self._U.shape[1]
+        scanned = P_b * cap
+        if be == "fused":
+            a_flops, a_bytes = _devprof.fused_score_cost(
+                b, scanned, rank, lk, self.factor_dtype
+            )
+            self.devprof.set_cost(
+                b, a_flops, a_bytes, source="analytic-fused"
+            )
+        else:
+            a_flops, a_bytes = _devprof.score_cost(
+                b, scanned, rank, dtype=self.factor_dtype
+            )
+            self.devprof.set_cost(b, a_flops, a_bytes, source="analytic")
+        self._cost_bytes[b] = a_bytes
         return compiled
 
     def _compile_sharded(self, b: int):
@@ -576,6 +808,13 @@ class BucketedScorer:
                         np.asarray(idx)[: len(chunk), :k], b, wall,
                         self._cost_bytes.get(b, 0.0),
                     )
+                if self.retrieval == "ivf":
+                    self._ivf_dispatches += 1
+                    self._ivf_probed_blocks += self._probes[b]
+                    self._ivf_scanned_rows += (
+                        self._probes[b] * self._ivf_layout.cap_pad
+                    )
+                    self._ivf_dispatch_rows += b
             # padded tail rows are real top-k rows for user 0 — dropped here
             idx_parts.append(np.asarray(idx)[: len(chunk), :k])
             val_parts.append(np.asarray(vals)[: len(chunk), :k])
@@ -670,11 +909,45 @@ class BucketedScorer:
                     (dev or {}).get("busy_fraction"),
                     self.resident_shard_bytes,
                 )
+            retrieval = None
+            if self.retrieval == "ivf":
+                index = self.ivf_index
+                # scanned fraction: item rows the probe scans streamed /
+                # rows the exact path would have streamed for the same
+                # dispatches.  Per DISPATCH, not per row — one matmul
+                # over the probe blocks serves every row in the rung,
+                # exactly as one exact full scan would, so this is the
+                # honest HBM-bytes ratio between the two paths.
+                denom = self._ivf_dispatches * self._exact_items_pad
+                retrieval = {
+                    "backend": "ivf",
+                    "nlist": index.nlist,
+                    "nprobe": self._nprobe,
+                    "min_probes": self._min_probes,
+                    "cap_pad": self._ivf_layout.cap_pad,
+                    "probes_per_rung": {
+                        str(b): p for b, p in self._probes.items()
+                    },
+                    "dispatches": self._ivf_dispatches,
+                    "dispatch_rows": self._ivf_dispatch_rows,
+                    "probed_blocks": self._ivf_probed_blocks,
+                    "scanned_rows": self._ivf_scanned_rows,
+                    "scanned_fraction": round(
+                        self._ivf_scanned_rows / denom, 6
+                    )
+                    if denom
+                    else None,
+                    "resident_extra_bytes": self._ivf_extra_bytes,
+                    "recall_at_publish": index.recall_at_publish,
+                    "fingerprint": index.fingerprint,
+                }
             return {
                 "buckets": list(self.buckets),
                 "top_k": self.k,
                 "serving_backend": self.sharding,
                 "sharding": sharding,
+                "retrieval_backend": self.retrieval,
+                "retrieval": retrieval,
                 "kernel": kernel,
                 "compile_count": self.compile_count,
                 "bucket_hits": {str(b): h for b, h in hits.items()},

@@ -501,6 +501,9 @@ class QueryServer:
         # pio_shard_*: emits only while a ShardingPlan is live (the stats
         # block is absent under replicated placement)
         _bridges.bridge_sharding(reg, self._fastpath_stats)
+        # pio_ivf_*: emits only while an IVF index is live (the stats
+        # block is absent under exact retrieval)
+        _bridges.bridge_ivf(reg, self._fastpath_stats)
         # live device utilization: the scorer's cost-annotated dispatch
         # accountant, labeled with the generation it serves (the scorer —
         # and its accountant — are rebuilt on every successful reload)

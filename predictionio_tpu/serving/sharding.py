@@ -253,6 +253,53 @@ def build_plan(
     return plan
 
 
+def plan_from_assignment(
+    assignment: np.ndarray,
+    *,
+    weights: Optional[np.ndarray] = None,
+    strategy: str = "contiguous",
+) -> ShardingPlan:
+    """Build a plan from an EXPLICIT item→shard assignment.
+
+    The layout/merge machinery (``build_layout``, fingerprinting, the
+    sealed-blob round trip) only needs the assignment itself — this is
+    the entry point for partitions computed elsewhere, e.g. the IVF
+    coarse quantizer (``ops/ivf.py``) whose k-means clusters become the
+    "shards" of a coarse-partition layout.  ``strategy`` is recorded
+    verbatim in the plan (it names the producer, not one of
+    :data:`STRATEGIES`); shard count is taken from the assignment, which
+    must leave no shard empty (drop empty clusters before calling).
+    """
+    assignment = np.ascontiguousarray(assignment, np.int32)
+    if assignment.ndim != 1 or assignment.size == 0:
+        raise ValueError("assignment must be a non-empty 1-D item→shard map")
+    n_items = int(assignment.shape[0])
+    n_shards = int(assignment.max()) + 1
+    if weights is None:
+        w = np.ones(n_items, np.float64)
+    else:
+        w = np.asarray(weights, np.float64).reshape(-1)
+        if w.shape[0] != n_items:
+            raise ValueError(
+                f"weights cover {w.shape[0]} items, catalog has {n_items}"
+            )
+    per_shard = np.zeros(n_shards, np.float64)
+    np.add.at(per_shard, assignment, w)
+    total = per_shard.sum()
+    load_share = (
+        per_shard / total if total > 0
+        else np.full(n_shards, 1.0 / n_shards)
+    )
+    plan = ShardingPlan(
+        n_shards=n_shards,
+        assignment=assignment,
+        strategy=strategy,
+        load_share=load_share,
+    )
+    plan.validate(n_items)
+    return plan
+
+
 def plan_from_env(
     n_items: int,
     weights: Optional[np.ndarray] = None,

@@ -816,6 +816,105 @@ def cmd_shards(args) -> int:
     return 0
 
 
+def cmd_ivf(args) -> int:
+    """Inspect or rebuild a published model's IVF retrieval index.
+
+    ``show`` reads the sealed ivf.blob beside a checkpoint-persisted
+    model's factors; ``rebuild`` retrains the k-means coarse partition
+    offline, re-runs the recall@10 publish gate against the exact
+    ranking, and — only if it clears the threshold — republishes the
+    index through the same atomic sealed-blob machinery as ``pio shards
+    rebuild``, so a live server picks it up on ``POST /reload``.  A
+    below-threshold rebuild refuses and leaves the deployed artifacts
+    untouched.
+    """
+    import os
+    import pickle
+
+    from predictionio_tpu.ops import ivf as _ivf
+    from predictionio_tpu.utils.fs import pio_base_dir
+
+    base = os.path.join(pio_base_dir(), "persistent_models")
+
+    def index_path(iid: str) -> str:
+        return os.path.join(base, iid, "ivf.blob")
+
+    if args.ivf_command == "show":
+        if args.instance:
+            instances = [args.instance]
+        elif os.path.isdir(base):
+            instances = sorted(os.listdir(base))
+        else:
+            instances = []
+        rows = []
+        for iid in instances:
+            p = index_path(iid)
+            if not os.path.exists(p):
+                if args.instance:
+                    print(f"[INFO] {iid}: no IVF index (exact retrieval)")
+                continue
+            try:
+                index = _ivf.load_index(p)
+                rows.append({"instance": iid, **index.describe()})
+            except Exception as e:
+                rows.append({"instance": iid, "error": str(e)})
+        print(json.dumps(rows, indent=2))
+        return 0
+
+    # rebuild
+    iid = args.instance
+    d = os.path.join(base, iid)
+    maps_path = os.path.join(d, "maps.pkl")
+    if not os.path.exists(maps_path):
+        return _die(f"no checkpoint-persisted model at {d}")
+    from predictionio_tpu.core.checkpoint import restore_pytree
+
+    factors = restore_pytree(os.path.join(d, "factors"))
+    U, V = factors["user_factors"], factors["item_factors"]
+    try:
+        index = _ivf.build_index(V, args.nlist, nprobe=args.nprobe)
+    except ValueError as e:
+        return _die(f"cannot build IVF index: {e}")
+    k = min(10, int(V.shape[0]))
+    threshold = float(
+        args.min_recall
+        if args.min_recall is not None
+        else os.environ.get("PIO_IVF_MIN_RECALL", "0.95")
+    )
+    recall = _ivf.measure_recall(U, V, index, k=k)
+    if recall < threshold:
+        return _die(
+            f"IVF rebuild REFUSED: recall@{k} {recall:.4f} < "
+            f"{threshold:.4f}; the deployed index is untouched"
+        )
+    import dataclasses
+
+    index = dataclasses.replace(
+        index, recall_at_publish=recall,
+        recall_threshold=threshold, recall_k=k,
+    )
+    _ivf.save_index(index_path(iid), index)
+    with open(maps_path, "rb") as f:
+        meta = pickle.load(f)
+    meta["ivf"] = {
+        "nlist": index.nlist, "nprobe": index.nprobe,
+        "recall": recall, "threshold": threshold, "k": k,
+        "fingerprint": index.fingerprint,
+    }
+    tmp = f"{maps_path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, maps_path)
+    print(json.dumps({"instance": iid, **index.describe()}, indent=2))
+    print(
+        "[INFO] Index resealed. POST /reload on the serving deployment to "
+        "pick it up (the LKG machinery guards the swap)."
+    )
+    return 0
+
+
 def cmd_loadtest(args) -> int:
     from predictionio_tpu.tools.loadtest import run_ingest_loadtest, run_loadtest
 
@@ -1252,6 +1351,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="popularity weights: item-factor L2 norms (the "
                    "traffic proxy) or uniform")
     x.set_defaults(func=cmd_shards)
+
+    sp = sub.add_parser(
+        "ivf", help="inspect or rebuild a published model's IVF "
+        "approximate-retrieval index",
+    )
+    ivf_sub = sp.add_subparsers(dest="ivf_command", required=True)
+    x = ivf_sub.add_parser(
+        "show", help="print the sealed IVF index of one (or every) "
+        "checkpoint-persisted model instance",
+    )
+    x.add_argument("--instance", default=None)
+    x.set_defaults(func=cmd_ivf)
+    x = ivf_sub.add_parser(
+        "rebuild", help="retrain the k-means coarse partition offline, "
+        "re-run the recall gate, and reseal ivf.blob; a live server "
+        "adopts it on POST /reload",
+    )
+    x.add_argument("--instance", required=True)
+    x.add_argument("--nlist", type=int, required=True,
+                   help="cluster count for the coarse partition")
+    x.add_argument("--nprobe", type=int, default=None,
+                   help="default probe count (default: nlist // 8)")
+    x.add_argument("--min-recall", type=float, default=None,
+                   help="recall@10 gate (default: PIO_IVF_MIN_RECALL "
+                   "or 0.95)")
+    x.set_defaults(func=cmd_ivf)
 
     sp = sub.add_parser("undeploy")
     sp.add_argument("--ip", default="127.0.0.1")

@@ -137,6 +137,17 @@ def summarize_metrics(series: dict) -> dict:
             "pio_kernel_resident_factor_bytes"
         )
         out["kernelIntensity"] = latest("pio_kernel_intensity_flops_per_byte")
+    # retrieval identity (ISSUE 16): pio_ivf_* emits only while an IVF
+    # index is live, so its presence IS the backend signal — a deploy
+    # meant to serve IVF that reports "exact" degraded at load/resolve
+    if "kernelBackend" in out:
+        out["retrievalBackend"] = "exact"
+    for (name, labels), v in series.items():
+        if name == "pio_ivf_info" and v:
+            out["retrievalBackend"] = "ivf"
+    if latest("pio_ivf_nprobe") is not None:
+        out["ivfNprobe"] = latest("pio_ivf_nprobe")
+        out["ivfScannedFraction"] = latest("pio_ivf_scanned_fraction")
     for (name, labels), v in sorted(series.items()):
         if name.endswith("_breaker_state"):
             out.setdefault("breakerStates", {})[

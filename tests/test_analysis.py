@@ -179,6 +179,23 @@ HOTPATH_FIXTURE = {
         def _compile_scorer(model):
             return jax.jit(model)
     """,
+    # IVF retrieval entry points (ops/ivf.py idiom): probe_*/retrieve_*
+    # run per cache-miss query, so compiling there stalls a live request
+    # — while the publish-time k-means trainer compiles lazily by design.
+    "serving/retrieval.py": """\
+        import jax
+
+        def probe_clusters(model, q):
+            f = jax.jit(model)
+            return f(q)
+
+        def retrieve_candidates(model, q):
+            f = jax.jit(model)
+            return f(q)
+
+        def train_kmeans(model, v):
+            return jax.jit(model)(v)
+    """,
     # Pallas kernels: a bare-name kernel and a partial-specialised one
     # (ops/score_kernel.py idiom) must both register as traced — the
     # partial's bound keywords are static and branch-safe, while a host
@@ -244,7 +261,13 @@ def test_hotpath_positives_and_negatives(tmp_path):
     }
     assert symbols(rep, "hotpath-traced-loop") == {"bad_loop.xs"}
     assert symbols(rep, "hotpath-block-sync") == {"handle_query"}
-    assert symbols(rep, "hotpath-jit-in-request") == {"recommend"}
+    assert symbols(rep, "hotpath-jit-in-request") == {
+        "recommend", "probe_clusters", "retrieve_candidates",
+    }
+    # the publish-time trainer is NOT a request entry point
+    assert not any(
+        "train_kmeans" in s for s in symbols(rep, "hotpath-jit-in-request")
+    )
     # static args, shape checks, warmup fences, compile helpers, and
     # partial-bound kernel keywords (branching on `flag`): clean
     all_syms = {f.symbol for f in rep.findings}
@@ -425,6 +448,31 @@ BLOCKING_FIXTURE = {
                 time.sleep(0.01)  # not a hot-loop name: out of scope
                 return x
     """,
+    # ops/ivf.py is a dispatch module: probe selection runs per query,
+    # while the publish-time k-means/recall/blob machinery is exempt
+    "ops/ivf.py": """\
+        import json
+        import time
+
+        def probe_select(q, centroids):
+            time.sleep(0.001)
+            return q
+
+        def train_kmeans(v, nlist):
+            time.sleep(0.01)  # publish-time: exempt
+            return v
+
+        def save_index(path, index):
+            with open(path, "wb") as f:  # sealed-blob write: exempt
+                f.write(json.dumps(index).encode())
+    """,
+    "ops/other_kernel.py": """\
+        import time
+
+        def launch(x):
+            time.sleep(0.01)  # not a dispatch module: out of scope
+            return x
+    """,
 }
 
 
@@ -432,7 +480,8 @@ def test_blocking_positives_and_negatives(tmp_path):
     root = make_repo(tmp_path, BLOCKING_FIXTURE)
     rep = run(root, analyzers=["blocking"])
     syms = symbols(rep, "blocking-call-in-hot-loop")
-    assert syms == {"dispatch.sleep", "dispatch.dumps", "_flush.sleep"}
+    assert syms == {"dispatch.sleep", "dispatch.dumps", "_flush.sleep",
+                    "probe_select.sleep"}
 
 
 # -- lockorder ----------------------------------------------------------------

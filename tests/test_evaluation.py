@@ -250,3 +250,79 @@ class TestTemplateEvaluation:
 
         with pytest.raises(ValueError, match="metric"):
             RecommendationEvaluation(metric="nope")
+
+
+class TestRecallAtK:
+    """recall@k for approximate retrieval (the PIO_IVF_MIN_RECALL gate)."""
+
+    def test_exact_match_and_order_independence(self):
+        import numpy as np
+
+        from predictionio_tpu.core.evaluation import recall_at_k
+
+        exact = np.array([[3, 1, 2], [5, 4, 0]])
+        assert recall_at_k(exact, exact, 3) == 1.0
+        # set semantics: a tie broken the other way is NOT a miss
+        shuffled = np.array([[2, 3, 1], [0, 5, 4]])
+        assert recall_at_k(exact, shuffled, 3) == 1.0
+
+    def test_partial_recall(self):
+        import numpy as np
+
+        from predictionio_tpu.core.evaluation import recall_at_k
+
+        exact = np.array([[0, 1, 2, 3]])
+        approx = np.array([[0, 1, 7, 8]])
+        assert recall_at_k(exact, approx, 4) == pytest.approx(0.5)
+
+    def test_padding_ids_excluded_both_sides(self):
+        import numpy as np
+
+        from predictionio_tpu.core.evaluation import recall_at_k
+        from predictionio_tpu.serving.sharding import PAD_SENTINEL
+
+        # -1 (merge padding) and PAD_SENTINEL (layout padding) are not
+        # items: they neither count as retrievable nor as retrieved
+        exact = np.array([[4, 9, -1, PAD_SENTINEL]])
+        approx = np.array([[9, 4, PAD_SENTINEL, -1]])
+        assert recall_at_k(exact, approx, 4) == 1.0
+        # a pad in the approx row must not substitute for a real hit
+        assert recall_at_k(
+            np.array([[4, 9]]), np.array([[4, -1]]), 2
+        ) == pytest.approx(0.5)
+
+    def test_k_larger_than_candidates(self):
+        import numpy as np
+
+        from predictionio_tpu.core.evaluation import recall_at_k
+
+        # only 2 real exact ids: denominator is min(k, 2), not k
+        exact = np.array([[6, 2, -1, -1]])
+        approx = np.array([[2, 6, -1, -1]])
+        assert recall_at_k(exact, approx, 10) == 1.0
+
+    def test_nothing_retrievable_is_perfect(self):
+        import numpy as np
+
+        from predictionio_tpu.core.evaluation import recall_at_k
+
+        exact = np.array([[-1, -1]])
+        approx = np.array([[-1, -1]])
+        assert recall_at_k(exact, approx, 2) == 1.0
+
+    def test_row_mismatch_raises(self):
+        import numpy as np
+
+        from predictionio_tpu.core.evaluation import recall_at_k
+
+        with pytest.raises(ValueError):
+            recall_at_k(np.zeros((2, 3)), np.zeros((3, 3)), 3)
+
+    def test_single_row_1d_inputs(self):
+        import numpy as np
+
+        from predictionio_tpu.core.evaluation import recall_at_k
+
+        assert recall_at_k(
+            np.array([1, 2, 3]), np.array([3, 1, 9]), 3
+        ) == pytest.approx(2.0 / 3.0)

@@ -34,7 +34,7 @@ _PIN = {"BENCH_REBALANCE": "1", "BENCH_DTYPE": "f32"}
 _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
          "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0",
          "BENCH_KERNEL": "0", "BENCH_TRAIN_KERNEL": "0", "BENCH_FLEET": "0",
-         "BENCH_ELASTIC": "0", "BENCH_SHARDED": "0"}
+         "BENCH_ELASTIC": "0", "BENCH_SHARDED": "0", "BENCH_RETRIEVAL": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -285,6 +285,20 @@ def main() -> int:
             ) if shd_plans else None,
             "gate_pass": shd.get("gate_pass"),
         },
+    }
+    # IVF retrieval gate (ISSUE 16): at the default nprobe the pruned scan
+    # must keep recall@10 >= 0.95 against the exact scorer while touching
+    # <= 0.2 of the catalog's padded rows — both halves of the trade at
+    # once, measured on the primary cell's clustered catalog
+    rtr = primary.get("retrieval") or {}
+    artifact["retrieval"] = {
+        "nlist": rtr.get("nlist"),
+        "nprobe": rtr.get("nprobe"),
+        "recall_at_10": rtr.get("recall_at_10"),
+        "scanned_fraction": rtr.get("scanned_fraction"),
+        "analytic_scan_speedup": rtr.get("analytic_scan_speedup"),
+        "measured": rtr.get("measured"),
+        "gate_pass": rtr.get("gate_pass"),
     }
     # static-analysis gate: perf numbers from a repo carrying hot-path or
     # race hazards are not publishable — `pio analyze` must report zero
