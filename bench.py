@@ -1731,6 +1731,320 @@ def _elastic_bench(ctx) -> dict:
     return out
 
 
+def _freshness_bench(ctx) -> dict:
+    """Streaming-freshness evidence: sustained query load against an
+    autoscaled two-replica fleet while the in-process event plane folds
+    committed events into sealed micro-generation deltas and the router
+    propagates each one to every replica.
+
+    Three numbers matter: ``visible_p99_ms`` (event submitted →
+    prediction-visible on every replica, i.e. WAL ack + group-commit +
+    fold-in + seal + router push + in-place apply), ``apply_wall_ms``
+    (the router→fleet propagation round-trip alone), and
+    ``lost_acked_events`` (must be zero — every fast-acked event id is
+    found back in storage after the run).  The gate is all of: every
+    batch sealed, every push acked by the full fleet, visible p99 within
+    ``PIO_FRESHNESS_SLO_MS``, zero lost acked events, zero client-visible
+    query errors while the deltas landed.
+    """
+    import copy as _copy
+    import shutil
+    import socket
+    import tempfile
+    import threading
+    import urllib.request as _urlreq
+
+    import predictionio_tpu
+    from predictionio_tpu.core import delta as _delta
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import Event
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.data.api.event_server import EventServer
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.data.storage.sqlite import close_db
+    from predictionio_tpu.serving.autoscaler import Autoscaler
+    from predictionio_tpu.serving.fleet import FleetSupervisor
+    from predictionio_tpu.serving.query_server import QueryServer
+    from predictionio_tpu.serving.router import ADMITTED, Router
+    from predictionio_tpu.templates.recommendation import (
+        RecommendationEngine,
+    )
+
+    batches = int(os.environ.get("BENCH_FRESHNESS_BATCHES", 10))
+    per_batch = int(os.environ.get("BENCH_FRESHNESS_EVENTS", 24))
+    slo_ms = float(os.environ.get("PIO_FRESHNESS_SLO_MS", "5000"))
+    tmp = tempfile.mkdtemp(prefix="pio-freshness-bench-")
+    src = "FRESHB"
+    storage_env = {
+        f"PIO_STORAGE_SOURCES_{src}_TYPE": "sqlite",
+        f"PIO_STORAGE_SOURCES_{src}_PATH": os.path.join(
+            tmp, "events.sqlite"
+        ),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+    }
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("PIO_FS_BASEDIR", "PIO_STREAMING", "PIO_DELTA_DIR",
+                  "PIO_DELTA_CATCHUP_MS")
+    }
+    os.environ["PIO_FS_BASEDIR"] = os.path.join(tmp, "fs")
+    os.environ["PIO_STREAMING"] = "1"
+    os.environ["PIO_DELTA_DIR"] = os.path.join(tmp, "deltas")
+    # visibility is router-push driven here; park the replica poll pace
+    # so catch-up slack never flatters the measurement
+    os.environ["PIO_DELTA_CATCHUP_MS"] = "60000"
+    routers: list = []
+    fleets: list = []
+    scalers: list = []
+    event_servers: list = []
+    stop_load = threading.Event()
+    load_threads: list = []
+    out: dict = {}
+    try:
+        storage = Storage(env=storage_env)
+        store_mod.set_storage(storage)
+        app_id = storage.get_meta_data_apps().insert(App(0, "freshbench"))
+        le = storage.get_l_events()
+        le.init(app_id)
+        rng = np.random.default_rng(31)
+        events = []
+        for u in range(20):
+            for i in rng.choice(16, size=6, replace=False):
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties={"rating": float(rng.integers(1, 6))},
+                ))
+        le.batch_insert(events, app_id)
+        engine = RecommendationEngine.apply()
+        ep = engine.params_from_variant({
+            "datasource": {"params": {"appName": "freshbench"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 3}}
+            ],
+        })
+        run_train(engine, ep, "fresh", storage=storage, ctx=ctx)
+
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(predictionio_tpu.__file__))
+        )
+        child_env = dict(os.environ)
+        child_env.pop("PIO_FAULT_SPEC", None)
+        child_env.update(storage_env)
+        child_env["JAX_PLATFORMS"] = "cpu"
+        child_env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root] + ([child_env["PYTHONPATH"]]
+                           if child_env.get("PYTHONPATH") else [])
+        )
+
+        def spawn(port):
+            cenv = dict(child_env)
+            cenv["FLEET_CHILD_PORT"] = str(port)
+            return subprocess.Popen(
+                [sys.executable, "-c", _FLEET_CHILD], env=cenv,
+            )
+
+        socks = [socket.socket() for _ in range(2)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+
+        r = Router(
+            [f"http://127.0.0.1:{p}" for p in ports],
+            hedge_enabled=False, telemetry=False,
+        )
+        r.health_interval_ms = 100.0
+        r.outlier_ratio = 1e9
+        routers.append(r)
+        rport = r.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{rport}"
+
+        fleet = FleetSupervisor(spawn, ports, router=r)
+        fleets.append(fleet)
+        r.attach_fleet(fleet)
+        fleet.start()
+
+        t_end = time.time() + 180.0
+        while time.time() < t_end:
+            reps = r.stats()["replicas"]
+            if reps and all(x["state"] == ADMITTED
+                            and x["generation"] is not None for x in reps):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("freshness bench replicas never became ready")
+
+        # the scaler runs for real (evaluates every tick) but the rank-4
+        # CPU workload keeps utilization far under the threshold, so the
+        # fleet holds steady and every push can be gated on full-fleet
+        # acknowledgement
+        scaler = Autoscaler(r, fleet)
+        scaler.interval_ms = 300.0
+        scaler.min_replicas = 2
+        scaler.max_replicas = 3
+        scaler.up_threshold = 0.9
+        scaler.busy_enabled = False  # telemetry=False children: no /metrics
+        scalers.append(scaler)
+        r.attach_autoscaler(scaler)
+        scaler.start()
+
+        # event plane: its own copy of the SAME deployed base generation
+        # the children serve, loaded through the identical deploy path so
+        # the delta fence (base fingerprint) matches across processes
+        qs_local = QueryServer(
+            engine, storage=storage, ctx=ctx, telemetry=False,
+        )
+        st_local = qs_local._streaming
+        if st_local is None:
+            raise RuntimeError("PIO_STREAMING=1 but streaming not enabled")
+        pub_model = _copy.deepcopy(st_local["model"])
+        delta_dir = st_local["dir"]
+        qs_local.stop()
+
+        es = EventServer(
+            storage=storage, ingest_mode="fast",
+            wal_dir=os.path.join(tmp, "wal"),
+            ingest_flush_ms=5.0, telemetry=False,
+        )
+        event_servers.append(es)
+        # gate off: this bench measures the pipeline's latency, not
+        # fold-in quality (the quality gate has its own chaos coverage)
+        pub = es.enable_delta_publisher(pub_model, min_overlap=0.0)
+        if pub is None:
+            raise RuntimeError("delta publisher did not enable")
+
+        load_counts = {"ok": 0, "errors": 0}
+        count_lock = threading.Lock()
+
+        def _load(worker):
+            i = worker
+            while not stop_load.is_set():
+                i += 1
+                body = json.dumps(
+                    {"user": f"u{i % 20}", "num": 3}
+                ).encode()
+                req = _urlreq.Request(
+                    base + "/queries.json", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with _urlreq.urlopen(req, timeout=10) as resp:
+                        resp.read()
+                        ok = resp.status == 200
+                except Exception:
+                    ok = False
+                with count_lock:
+                    load_counts["ok" if ok else "errors"] += 1
+                time.sleep(0.01)
+
+        for w in range(4):
+            t = threading.Thread(target=_load, args=(w,), daemon=True)
+            load_threads.append(t)
+            t.start()
+
+        log = _delta.DeltaLog(delta_dir)
+        acked_ids: list = []
+        visible_ms: list = []
+        apply_ms: list = []
+        seal_failures = 0
+        partial_pushes = 0
+        seq = 0
+        erng = np.random.default_rng(41)
+        for _ in range(batches):
+            t0 = time.time()
+            for _e in range(per_batch):
+                seq += 1
+                ev = Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"u{erng.integers(20)}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{erng.integers(16)}",
+                    properties={"rating": float(erng.integers(1, 6))},
+                    event_id=f"fresh-{seq:05d}",
+                )
+                es.ingest_buffer.submit(ev, app_id)  # WAL fast-ack
+                acked_ids.append(ev.event_id)
+            # the group-commit flush feeds the publisher within ~flush_ms
+            t_wait = time.time() + 30.0
+            while pub.pending() < per_batch and time.time() < t_wait:
+                time.sleep(0.002)
+            receipt = pub.flush()
+            if not (receipt and receipt.get("sealed")):
+                seal_failures += 1
+                continue
+            blob = open(log.path(receipt["epoch"]), "rb").read()
+            t_push = time.time()
+            acks = r.push_delta(blob)
+            now = time.time()
+            apply_ms.append((now - t_push) * 1000.0)
+            visible_ms.append((now - t0) * 1000.0)
+            if acks["acked"] != acks["replicas"]:
+                partial_pushes += 1
+        stop_load.set()
+        for t in load_threads:
+            t.join(timeout=15.0)
+
+        # zero-loss audit: every fast-acked event id must be in storage
+        stored = {e.event_id for e in le.find(app_id)}
+        lost = [i for i in acked_ids if i not in stored]
+        vis = sorted(visible_ms)
+        p99 = vis[min(len(vis) - 1, int(len(vis) * 0.99))] if vis else None
+        pstats = pub.stats()
+        out = {
+            "batches": batches,
+            "events_per_batch": per_batch,
+            "sealed": pstats["sealed"],
+            "seal_failures": seal_failures,
+            "partial_pushes": partial_pushes,
+            "visible_p99_ms": round(p99, 2) if p99 is not None else None,
+            "visible_max_ms": round(vis[-1], 2) if vis else None,
+            "apply_wall_ms": (
+                round(sorted(apply_ms)[len(apply_ms) // 2], 2)
+                if apply_ms else None
+            ),
+            "slo_ms": slo_ms,
+            "lost_acked_events": len(lost),
+            "query_ok": load_counts["ok"],
+            "query_errors": load_counts["errors"],
+            "scale_ups": scaler.stats()["scaleUps"],
+            "gate_pass": bool(
+                pstats["sealed"] == batches
+                and seal_failures == 0
+                and partial_pushes == 0
+                and p99 is not None
+                and p99 <= slo_ms
+                and not lost
+                and load_counts["errors"] == 0
+            ),
+        }
+    finally:
+        stop_load.set()
+        for t in load_threads:
+            t.join(timeout=5.0)
+        for es in event_servers:
+            es.stop()
+        for sc in scalers:
+            sc.stop()
+        for r in routers:
+            r.stop()
+        for f in fleets:
+            f.stop()
+        store_mod.set_storage(None)
+        close_db(os.path.join(tmp, "events.sqlite"))
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _sharded_serving_bench(ctx) -> dict:
     """Sharded-serving evidence (ISSUE 12): on the multi-device mesh, a
     catalog deliberately sized past one device's (simulated) HBM budget is
@@ -2150,6 +2464,14 @@ def main() -> None:
             print(f"WARNING: elastic bench failed: {e}", file=sys.stderr)
             elastic = {"error": str(e)}
         print(f"INFO: elastic: {elastic}", file=sys.stderr)
+    freshness = None
+    if os.environ.get("BENCH_FRESHNESS", "1") != "0":
+        try:
+            freshness = _freshness_bench(ctx)
+        except Exception as e:  # the freshness bench must never kill the artifact
+            print(f"WARNING: freshness bench failed: {e}", file=sys.stderr)
+            freshness = {"error": str(e)}
+        print(f"INFO: freshness: {freshness}", file=sys.stderr)
     sharded = None
     if os.environ.get("BENCH_SHARDED", "1") != "0":
         try:
@@ -2211,6 +2533,8 @@ def main() -> None:
         record["fleet"] = fleet
     if elastic is not None:
         record["elastic"] = elastic
+    if freshness is not None:
+        record["freshness"] = freshness
     if sharded is not None:
         record["multichip"] = {"sharded_serving": sharded}
     if retrieval is not None:

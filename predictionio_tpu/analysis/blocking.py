@@ -66,10 +66,12 @@ _EXEMPT_FUNCS = {"__init__", "_compile", "stats", "stop", "close",
 # worker-loop functions checked across the wider threaded scope
 # (_health_loop/_monitor_loop/_control_loop: the router's probe pacer,
 # the fleet supervisor's child watcher, and the autoscaler's decision
-# pacer — all must pace on Event.wait and delegate real I/O to
-# non-loop helpers)
+# pacer; _delta_loop/_catchup_loop: the event server's delta flush
+# worker and the replica's delta catch-up worker — all must pace on
+# Event.wait and delegate real I/O to non-loop helpers)
 _HOT_LOOP_NAMES = {"_loop", "_run", "_flush", "_drain",
-                   "_health_loop", "_monitor_loop", "_control_loop"}
+                   "_health_loop", "_monitor_loop", "_control_loop",
+                   "_delta_loop", "_catchup_loop"}
 
 # callee name → why it blocks
 _BLOCKING_ATTRS = {

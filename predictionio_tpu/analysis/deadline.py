@@ -77,10 +77,14 @@ R_STALE = rule(
 )
 
 # request-verb entry prefixes: hotpath's list minus the internal
-# boundary verbs (submit/dispatch name queue handoffs, not inbound HTTP)
+# boundary verbs (submit/dispatch name queue handoffs, not inbound HTTP).
+# push_delta / catchup cover the streaming delta plane: the router's
+# delta propagation hop and the replica catch-up workers make outbound
+# calls on behalf of the freshness pipeline and must carry (or
+# explicitly waive) the deadline contract like any other hop.
 _ENTRY_PREFIXES = (
     "recommend", "score", "predict", "query", "handle", "serve",
-    "lookup", "rank",
+    "lookup", "rank", "push_delta", "catchup",
 )
 # the storage client the ISSUE names: its DAO surface has no request
 # verbs but the query path flows straight through it

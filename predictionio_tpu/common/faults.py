@@ -25,6 +25,17 @@ SEEDED schedule, at named fault SITES compiled into the service planes:
   loop through :func:`kill_point`: a matching ``crash`` rule SIGKILLs one
   seeded-random *child* replica per firing, the preemption primitive the
   elastic-fleet chaos suite schedules mid-scale-up.
+* ``client:replica:delta`` — consulted by the router before each delta
+  push on the router→replica ``POST /delta`` hop (latency / simulated
+  drop / simulated 5xx): a replica that misses the push must catch up
+  from the sealed delta log before readmission, never diverge.
+* ``crash:delta:before_seal`` — compiled into ``DeltaLog.seal``: the
+  publisher dies after the ingest WAL ack but before the delta blob is
+  sealed; replay of the durable events must regrow the identical delta.
+* ``crash:delta:mid_apply`` — compiled into ``DeltaApplier._apply_one``:
+  a replica dies after receiving a delta but before recording it
+  applied; on restart it reloads clean base factors and catches up from
+  the sealed log (epoch fencing makes the replay exactly-once).
 
 Nothing fires unless a plan is installed — the shim is one ``is None``
 check on the hot path.  Installation is programmatic (:func:`install`,

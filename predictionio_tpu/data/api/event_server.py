@@ -20,6 +20,7 @@ Parity: ``data/.../data/api/EventServer.scala:61-560``:
 from __future__ import annotations
 
 import base64
+import collections
 import logging
 import os
 import threading
@@ -27,6 +28,7 @@ import time
 from typing import Optional
 
 from predictionio_tpu import obs
+from predictionio_tpu.core import delta as _delta
 from predictionio_tpu.common.http import HttpService, Request, Response, json_response
 from predictionio_tpu.data.api.ingest_buffer import (
     BufferFull,
@@ -116,6 +118,26 @@ class EventServer:
         self._drain_counts = {"drains": 0, "drained_events": 0,
                               "abandoned_events": 0}
         self._stopped = False
+        # streaming micro-generations (PIO_STREAMING=1): committed-event
+        # sinks beyond the cache-invalidation hook.  A bounded ring of
+        # recently committed events lets a publisher attached AFTER
+        # construction still see every acked event — the
+        # no-acked-event-loss contract.  MUST be initialized before the
+        # WAL replay below: replay commits through _notify_committed,
+        # which feeds this ring.
+        self._delta_sinks: list = []
+        self._delta_publisher = None
+        self._delta_flush_stop = threading.Event()
+        self._delta_flush_thread: Optional[threading.Thread] = None
+        self._recent_committed = (
+            collections.deque(
+                maxlen=max(
+                    4096, _env_num("PIO_DELTA_MAX_EVENTS", 512, int) * 8
+                )
+            )
+            if _delta.streaming_enabled()
+            else None
+        )
         # fast-ack WAL: journaled-before-202, replayed on startup — closes
         # the crash window the fast mode's docstring used to concede
         self.wal: Optional[WriteAheadLog] = None
@@ -232,6 +254,40 @@ class EventServer:
         storage_rs = getattr(self.storage, "resilience_stats", None)
         if callable(storage_rs):
             _bridges.bridge_resilience(reg, storage_rs)
+
+        def _delta_families():
+            # emits only while a delta publisher is live (PIO_STREAMING=1
+            # and enable_delta_publisher called): /metrics stays identical
+            # to the pre-streaming server otherwise
+            pub = self._delta_publisher
+            if pub is None:
+                return []
+            s = pub.stats()
+            F = _bridges.Family
+            return [
+                F("pio_delta_sealed_total", "counter",
+                  "Micro-generation deltas sealed into the log.",
+                  [("", (), float(s["sealed"]))]),
+                F("pio_delta_seal_refused_total", "counter",
+                  "Fold-ins quarantined before sealing (quality gate, "
+                  "empty fold).",
+                  [("", (), float(s["seal_refused"]))]),
+                F("pio_delta_events_folded_total", "counter",
+                  "Committed events folded into sealed deltas.",
+                  [("", (), float(s["events_folded"]))]),
+                F("pio_delta_unknown_users_total", "counter",
+                  "Events skipped because the user is not in the base "
+                  "generation (waits for the next full retrain).",
+                  [("", (), float(s["unknown_users"]))]),
+                F("pio_delta_pending_events", "gauge",
+                  "Committed events buffered toward the next fold.",
+                  [("", (), float(s["pending"]))]),
+                F("pio_delta_log_epoch", "gauge",
+                  "Newest epoch sealed in the publisher's delta log.",
+                  [("", (), float(s["log_epoch"]))]),
+            ]
+
+        reg.register_collector(_delta_families)
 
     # -- auth (parity: withAccessKey, EventServer.scala:92-130) ------------
     def _authenticate(self, req: Request) -> tuple[Optional[dict], Optional[Response]]:
@@ -445,6 +501,86 @@ class EventServer:
         except Exception:
             logger.exception("cache-invalidation hook failed; TTL backstop "
                              "bounds staleness")
+        if self._recent_committed is not None:
+            self._recent_committed.extend(events)
+        for sink in self._delta_sinks:
+            # same contract as the cache hook: a sink failure never fails
+            # a write that already landed (the delta pipeline regrows
+            # from the WAL / event store instead)
+            try:
+                sink(events)
+            except Exception:
+                logger.exception("delta sink failed; events remain durable "
+                                 "in storage for the next fold")
+
+    # -- streaming micro-generations (PIO_STREAMING=1) ---------------------
+    def attach_delta_sink(self, sink, replay_recent: bool = True) -> None:
+        """Register a committed-event sink.  ``replay_recent`` feeds the
+        bounded ring of events committed before attachment (WAL replay in
+        ``__init__``, early writes) into the new sink first, so a
+        publisher attached after construction still sees every acked
+        event."""
+        if replay_recent and self._recent_committed:
+            backlog = list(self._recent_committed)
+            try:
+                sink(backlog)
+            except Exception:
+                logger.exception("delta sink failed replaying %d committed "
+                                 "events", len(backlog))
+        self._delta_sinks.append(sink)
+
+    def enable_delta_publisher(self, model, delta_dir: Optional[str] = None,
+                               on_receipt=None, **publisher_kw):
+        """Fold committed events into sealed micro-generation deltas.
+
+        No-op (returns None) unless ``PIO_STREAMING=1``.  ``model`` is the
+        event plane's own copy of the deployed base generation; its
+        fingerprint routes the log to ``<delta_dir>/<fingerprint>/`` so
+        publishers and replicas of the same base agree on the epoch
+        sequence.  Starts the ``_delta_loop`` flush worker
+        (``PIO_DELTA_FLUSH_MS`` pace; size-triggered flushes still happen
+        inline at ``PIO_DELTA_MAX_EVENTS``).
+        """
+        if not _delta.streaming_enabled():
+            return None
+        fp = _delta.model_fingerprint(model.user_factors, model.item_factors)
+        directory = delta_dir or _delta.delta_dir_for(fp)
+        delta_log = _delta.DeltaLog(directory)
+        pub = _delta.DeltaPublisher(
+            model, delta_log, on_receipt=on_receipt, **publisher_kw
+        )
+        # single-writer rebind: enablement happens once, before the flush
+        # worker starts; sinks/metrics read None or the finished publisher
+        self._delta_publisher = pub  # pio: ignore[race-unguarded-rebind]
+        self.attach_delta_sink(pub.on_committed)
+        self._delta_flush_stop.clear()
+        t = threading.Thread(
+            target=self._delta_loop, name="eventserver-delta-flush",
+            daemon=True,
+        )
+        self._delta_flush_thread = t
+        t.start()
+        logger.info("delta publisher enabled: base %s, log %s", fp, directory)
+        return pub
+
+    def _delta_loop(self) -> None:
+        """Delta flush worker: paces on Event.wait and delegates the
+        fold/gate/seal work (and its file I/O) to the publisher."""
+        pace_s = _env_num("PIO_DELTA_FLUSH_MS", 250.0, float) / 1e3
+        while not self._delta_flush_stop.is_set():
+            self._delta_flush_stop.wait(pace_s)
+            if self._delta_flush_stop.is_set():
+                return
+            self._delta_flush_once()
+
+    def _delta_flush_once(self) -> None:
+        pub = self._delta_publisher
+        if pub is None:
+            return
+        try:
+            pub.flush()
+        except Exception:
+            logger.exception("delta flush failed; events remain buffered")
 
     def stats_update(self, auth: dict, event_name: str, status: int) -> None:
         if self.stats_enabled:
@@ -698,6 +834,10 @@ class EventServer:
             self._draining = True
             self._drain_counts["drains"] += 1
         clean = True
+        # the flush worker goes first, then one final fold so buffered
+        # events still seal before the buffer/WAL close under them
+        self._delta_flush_stop.set()
+        self._delta_flush_once()
         if self.ingest_buffer is not None:
             before = self.ingest_buffer.stats()["buffered"]
             drained = self.ingest_buffer.close(timeout=max(budget_s, 0.0))

@@ -540,6 +540,63 @@ def _solve_normal_equations(A, b, cnt, gram, rank, reg, implicit):
     return x.astype(jnp.float32)
 
 
+def _fold_in_dtype(compute_dtype: str):
+    if compute_dtype == "f64":
+        return np.float64
+    if compute_dtype == "bf16":
+        try:
+            import ml_dtypes
+            return ml_dtypes.bfloat16
+        except ImportError:
+            return np.float32
+    return np.float32
+
+
+def fold_in_users(item_factors, interactions, *, rank, reg,
+                  implicit=False, alpha=1.0, compute_dtype="f32"):
+    """Streaming user-side fold-in: re-solve user rows against FIXED items.
+
+    The micro-generation delta pipeline (``core/delta.py``) calls this
+    with each user's accumulated ``[(item_idx, rating), ...]`` history to
+    produce replacement user-factor rows without touching the item side —
+    the same normal equations one ALS half-step solves, restricted to the
+    affected users and evaluated host-side (batches are small; a device
+    round-trip or recompile would cost more than the solve).
+
+    ``compute_dtype`` degrades the gathered item rows exactly like the
+    training kernel's knob ("f32" | "bf16"; "f64" is the full-fidelity
+    reference the publish gate compares against); the accumulation and
+    solve always run in at least float32.
+
+    Returns an (n_users, rank) float32 array ordered by sorted user index.
+    """
+    V = np.asarray(item_factors, dtype=np.float32)
+    acc_dt = np.float64 if compute_dtype == "f64" else np.float32
+    gather_dt = _fold_in_dtype(compute_dtype)
+    eye = np.eye(rank, dtype=acc_dt)
+    gram = None
+    if implicit:
+        Vg = V.astype(gather_dt).astype(acc_dt)
+        gram = Vg.T @ Vg
+    rows = np.zeros((len(interactions), rank), dtype=np.float32)
+    for j, uidx in enumerate(sorted(interactions)):
+        pairs = interactions[uidx]
+        idx = np.array([i for i, _ in pairs], dtype=np.int64)
+        r = np.array([x for _, x in pairs], dtype=acc_dt)
+        Vu = V[idx].astype(gather_dt).astype(acc_dt)
+        if implicit:
+            # confidence c = 1 + alpha*r: A = VᵀV + Vuᵀ diag(alpha·r) Vu
+            # + reg·I, b = Vuᵀ c  (Hu-Koren-Volinsky fold-in)
+            A = gram + (Vu * (alpha * r)[:, None]).T @ Vu + reg * eye
+            b = Vu.T @ (1.0 + alpha * r)
+        else:
+            # λ·n_u ridge, matching _solve_normal_equations' explicit path
+            A = Vu.T @ Vu + (reg * len(pairs) + 1e-6) * eye
+            b = Vu.T @ r
+        rows[j] = np.linalg.solve(A, b).astype(np.float32)
+    return rows
+
+
 def _dense_half_step_local(
     *args, n_buckets, rank, reg, implicit, alpha, compute_dtype="f32",
     backend="reference", interpret=None,

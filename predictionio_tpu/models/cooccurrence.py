@@ -568,3 +568,44 @@ def _train_cooccurrence_sharded(
     if sh.cleanup is not None and distributed.should_write_storage():
         sh.cleanup()  # drop the rendezvous blobs (idempotent)
     return model
+
+
+# ---------------------------------------------------------------------------
+# Streaming micro-generation increments (core/delta.py)
+# ---------------------------------------------------------------------------
+
+
+def cooccurrence_increments(items_by_user: dict) -> np.ndarray:
+    """Pair-count increments from freshly committed interactions.
+
+    ``items_by_user`` maps a user index to the item indices of that
+    user's new events.  Every unordered within-user pair contributes one
+    ``(item_a, item_b, +count)`` row (``item_a < item_b``), the exact
+    increment the full-retrain co-occurrence Gram accumulates for those
+    events — so a delta carries the same counting signal the next full
+    rebuild will see, and the streaming accumulator converges to it.
+
+    Returns an (m, 3) int64 array, deduplicated and sorted.
+    """
+    counts: dict = {}
+    for items in items_by_user.values():
+        uniq = sorted(set(int(i) for i in items))
+        for i, a in enumerate(uniq):
+            for b in uniq[i + 1:]:
+                counts[(a, b)] = counts.get((a, b), 0) + 1
+    if not counts:
+        return np.zeros((0, 3), np.int64)
+    return np.array(
+        [(a, b, c) for (a, b), c in sorted(counts.items())], dtype=np.int64)
+
+
+def fold_increments(updates: np.ndarray, into: dict) -> dict:
+    """Apply delta pair increments to a replica's streaming accumulator.
+
+    ``into`` maps ``(item_a, item_b)`` to the accumulated pending count;
+    the replica exposes its size through stats so operators can see how
+    much co-occurrence signal is waiting on the next full rebuild."""
+    for a, b, c in np.asarray(updates, dtype=np.int64):
+        key = (int(a), int(b))
+        into[key] = into.get(key, 0) + int(c)
+    return into

@@ -360,7 +360,13 @@ class FleetSupervisor:
             pass
 
     def _wait_ready(self, url: str, deadline: float) -> None:
-        """Poll /readyz until 200 + warm; raise on timeout."""
+        """Poll /readyz until 200 + warm; raise on timeout.
+
+        Under streaming (PIO_STREAMING=1) a restarted or freshly spawned
+        replica answers 503 ``delta catch-up`` until it has replayed the
+        sealed delta log to the fleet's epoch — this wait is what keeps a
+        behind replica out of rotation until it has caught up.
+        """
         last = "no probe yet"
         while time.monotonic() < deadline:
             try:
@@ -370,7 +376,17 @@ class FleetSupervisor:
                     return
                 last = "ready but not warm"
             except urllib.error.HTTPError as e:
-                last = f"readyz {e.code}"
+                # surface WHY it is held out (draining / delta catch-up /
+                # overloaded) instead of a bare status code
+                try:
+                    status = json.loads(
+                        e.read().decode("utf-8")).get("status")
+                except (ValueError, OSError, AttributeError):
+                    status = None
+                last = (
+                    f"readyz {e.code} ({status})" if status
+                    else f"readyz {e.code}"
+                )
             except (OSError, ValueError) as e:
                 last = f"{type(e).__name__}: {e}"
             time.sleep(0.1)

@@ -45,7 +45,9 @@ from predictionio_tpu.common.resilience import (
     parse_deadline_header,
 )
 from predictionio_tpu import obs
+from predictionio_tpu.core import delta as _delta
 from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core.persistence import open_model_blob
 from predictionio_tpu.core.workflow import (
     get_latest_completed_instance,
     prepare_deploy,
@@ -248,6 +250,13 @@ class QueryServer:
         self._profile_lock = threading.Lock()
         self._profile_captures = 0
         self._profile_last_unix = 0.0
+        # streaming micro-generations (PIO_STREAMING=1): per-replica delta
+        # state dict built by enable_streaming() after each successful
+        # deploy/reload; None whenever streaming is off or no foldable
+        # model is live — every streaming touchpoint no-ops on None, which
+        # is what makes PIO_STREAMING=0 bit-identical to the pre-streaming
+        # server
+        self._streaming: Optional[dict] = None
         self._register_routes()
         self.reload()
         self._batcher = None
@@ -336,6 +345,9 @@ class QueryServer:
         with self._lock:
             self._reload_degraded = False
         self._record_last_known_good(instance.id)
+        # a new base generation subsumes all prior micro-generations:
+        # re-base the delta pipeline on the freshly deployed factors
+        self.enable_streaming()
         logger.info("deployed engine instance %s", instance.id)
         return instance.id
 
@@ -434,6 +446,207 @@ class QueryServer:
         return None
 
     # -- observability -------------------------------------------------------
+    # -- streaming micro-generations (crash-safe delta pipeline) -------------
+    def enable_streaming(
+        self, delta_dir: Optional[str] = None
+    ) -> Optional[dict]:
+        """Wire this replica into the sealed delta log (PIO_STREAMING=1).
+
+        Finds the first deployed factor model, fingerprints its base
+        generation, and builds the fenced :class:`DeltaApplier` over the
+        per-generation delta log.  Catch-up runs SYNCHRONOUSLY here —
+        before the caller (deploy/reload) lets ``/readyz`` go ready — so
+        a crash-restarted or freshly autoscaled replica is readmitted
+        only at the fleet's epoch, never behind it.  Returns the
+        applier's stats, or None when streaming is off or no foldable
+        model is deployed.
+        """
+        self._stop_streaming()
+        if not _delta.streaming_enabled():
+            return None
+        with self._lock:
+            d = self._deployed
+        if d is None:
+            return None
+        target = None
+        for algo, model in zip(d.algorithms, d.models):
+            if (
+                getattr(model, "user_factors", None) is not None
+                and getattr(model, "item_factors", None) is not None
+                and getattr(model, "user_map", None) is not None
+            ):
+                target = (algo, model)
+                break
+        if target is None:
+            return None
+        algo, model = target
+        fp = _delta.model_fingerprint(model.user_factors, model.item_factors)
+        directory = delta_dir or _delta.delta_dir_for(fp)
+        delta_log = _delta.DeltaLog(directory)
+        st: dict = {
+            "algo": algo,
+            "model": model,
+            "log": delta_log,
+            "dir": directory,
+            "fingerprint": fp,
+            # replica-local cooccurrence count accumulator (pair -> count)
+            "cooc": {},
+            "slo_ms": float(os.environ.get("PIO_FRESHNESS_SLO_MS", "5000")),
+            "degraded_served": 0,
+            "staleness_ms": 0.0,
+            "staleness_checked": 0.0,
+            "wedged": None,
+            "wake": threading.Event(),
+            "stop": threading.Event(),
+            "thread": None,
+        }
+        st["applier"] = _delta.DeltaApplier(
+            fp,
+            lambda dl: self._apply_streaming_delta(st, dl),
+            delta_log=delta_log,
+        )
+        # single-writer rebind: enable runs on the deploy/reload thread
+        # before the catch-up worker starts; readers see None or a fully
+        # built state dict, never a partial one
+        self._streaming = st  # pio: ignore[race-unguarded-rebind]
+        # catch-up before readmission: replay every already-sealed epoch
+        # while /readyz still answers not-ready for this generation
+        self._streaming_catch_up(st)
+        t = threading.Thread(
+            target=self._catchup_loop,
+            name="queryserver-delta-catchup",
+            daemon=True,
+        )
+        st["thread"] = t
+        t.start()
+        logger.info(
+            "streaming enabled: base %s, delta log %s, epoch %d",
+            fp, directory, st["applier"].applied_epoch,
+        )
+        return st["applier"].stats()
+
+    def _stop_streaming(self) -> None:
+        st = self._streaming
+        self._streaming = None
+        if st is not None:
+            st["stop"].set()
+            st["wake"].set()
+
+    def _apply_streaming_delta(self, st: dict, dl) -> None:
+        """In-place application of one fenced delta (DeltaApplier's
+        apply_fn): device factor buffers first, then the host-side model
+        copies, the cooccurrence counts, and the entity-targeted result
+        cache invalidation.  Bucket shapes never change, so nothing here
+        can trigger a recompile."""
+        import numpy as np
+
+        algo, model = st["algo"], st["model"]
+        user_idx = np.asarray(dl.user_idx, dtype=np.int64)
+        item_idx = (
+            np.asarray(dl.item_idx, dtype=np.int64)
+            if dl.item_idx is not None
+            else np.zeros((0,), np.int64)
+        )
+        scorer = getattr(algo, "_fastpath", None)
+        if scorer is not None:
+            scorer.apply_delta_rows(
+                dl.user_idx, dl.user_rows,
+                item_idx=dl.item_idx, item_rows=dl.item_rows,
+            )
+        # host factors track the delta so the next reload's last-known-good
+        # comparisons, fold-in gates and fallback paths all see fresh rows
+        if user_idx.size:
+            model.user_factors[user_idx] = np.asarray(
+                dl.user_rows, dtype=model.user_factors.dtype
+            )
+        if item_idx.size:
+            model.item_factors[item_idx] = np.asarray(
+                dl.item_rows, dtype=model.item_factors.dtype
+            )
+        # ALSScorer's own lazy device copies (the unbatched _score_batch
+        # path): U/V ride as call arguments, so a functional row patch
+        # swaps data without touching any compiled executable
+        dev_u = getattr(algo, "_U", None)
+        if dev_u is not None and user_idx.size:
+            algo._U = dev_u.at[user_idx].set(
+                np.asarray(dl.user_rows).astype(dev_u.dtype)
+            )
+        dev_v = getattr(algo, "_V", None)
+        if dev_v is not None and item_idx.size:
+            algo._V = dev_v.at[item_idx].set(
+                np.asarray(dl.item_rows).astype(dev_v.dtype)
+            )
+        if dl.cooc_updates is not None and len(dl.cooc_updates):
+            from predictionio_tpu.models.cooccurrence import fold_increments
+
+            fold_increments(dl.cooc_updates, st["cooc"])
+        # entity-targeted: only the users this delta rewrote lose their
+        # cached answers; everyone else stays hot
+        from predictionio_tpu.serving import result_cache as _rc
+
+        _rc.notify_delta(dl.user_ids)
+
+    def _streaming_staleness_ms(self) -> float:
+        """Age of the oldest sealed-but-unapplied epoch, cached for 250ms
+        so the per-query SLO check never turns into a per-query listdir."""
+        st = self._streaming
+        if st is None:
+            return 0.0
+        now = time.monotonic()
+        if now - st["staleness_checked"] >= 0.25:
+            try:
+                age = st["log"].oldest_unapplied_age_s(
+                    st["applier"].applied_epoch
+                )
+            except OSError:
+                age = 0.0
+            st["staleness_ms"] = age * 1000.0
+            st["staleness_checked"] = now
+        return st["staleness_ms"]
+
+    def _catchup_loop(self) -> None:
+        """Delta catch-up worker: paces on Event.wait (woken early by
+        /readyz when it spots the log ahead of us) and delegates the
+        blob I/O to the applier."""
+        st = self._streaming
+        if st is None:
+            return
+        pace_s = float(os.environ.get("PIO_DELTA_CATCHUP_MS", "1000")) / 1e3
+        while not st["stop"].is_set():
+            st["wake"].wait(pace_s)
+            st["wake"].clear()
+            if st["stop"].is_set():
+                return
+            self._streaming_catch_up(st)
+
+    def _streaming_catch_up(self, st: dict) -> None:
+        try:
+            rc = st["applier"].catch_up()
+        except Exception:
+            self._rl_log.exception("delta", "delta catch-up failed")
+            return
+        # a refused catch-up (torn blob, fingerprint fence, gap) wedges
+        # at the last good epoch: remember the receipt so /readyz stops
+        # holding the replica out — it serves degraded instead of
+        # flapping between 503 and a replay that can never succeed
+        st["wedged"] = rc if rc.get("refused") else None
+
+    def streaming_stats(self) -> Optional[dict]:
+        st = self._streaming
+        if st is None:
+            return None
+        out = st["applier"].stats()
+        out.update(
+            log_epoch=st["log"].last_epoch(),
+            staleness_ms=self._streaming_staleness_ms(),
+            slo_ms=st["slo_ms"],
+            degraded_served=st["degraded_served"],
+            cooc_pairs=len(st["cooc"]),
+            fingerprint=st["fingerprint"],
+            dir=st["dir"],
+        )
+        return out
+
     def _fastpath_stats(self) -> Optional[dict]:
         """First deployed algorithm's serving_stats (registry bridge)."""
         with self._lock:
@@ -575,6 +788,57 @@ class QueryServer:
             ]
 
         reg.register_collector(_serving_families)
+
+        def _streaming_families():
+            # emits only while streaming is live: PIO_STREAMING=0 keeps
+            # /metrics byte-identical to the pre-streaming server
+            st = self._streaming
+            if st is None:
+                return []
+            a = st["applier"].stats()
+            refused = a["refused"] or {}
+            F = _bridges.Family
+            return [
+                F("pio_delta_epoch", "gauge",
+                  "Micro-generation epoch applied by this replica.",
+                  [("", (), float(a["applied_epoch"]))]),
+                F("pio_delta_log_epoch", "gauge",
+                  "Newest epoch sealed in this replica's delta log.",
+                  [("", (), float(st["log"].last_epoch()))]),
+                F("pio_delta_applied_total", "counter",
+                  "Deltas applied in place on the serving factors.",
+                  [("", (), float(a["applied"]))]),
+                F("pio_delta_noop_total", "counter",
+                  "Replayed already-applied epochs acked as no-ops "
+                  "(the exactly-once path).",
+                  [("", (), float(a["noops"]))]),
+                F("pio_delta_refused_total", "counter",
+                  "Deltas refused by reason (fingerprint fence, gap, "
+                  "integrity).",
+                  [("", (("reason", r),), float(n))
+                   for r, n in sorted(refused.items())] or
+                  [("", (("reason", "none"),), 0.0)]),
+                F("pio_delta_cooc_pending", "gauge",
+                  "Distinct cooccurrence pairs accumulated from applied "
+                  "deltas since the last full retrain.",
+                  [("", (), float(len(st["cooc"])))]),
+                F("pio_freshness_staleness_ms", "gauge",
+                  "Age of the oldest sealed-but-unapplied delta epoch.",
+                  [("", (), float(self._streaming_staleness_ms()))]),
+                F("pio_freshness_slo_ms", "gauge",
+                  "Configured freshness SLO (PIO_FRESHNESS_SLO_MS).",
+                  [("", (), float(st["slo_ms"]))]),
+                F("pio_freshness_visible_p99_ms", "gauge",
+                  "p99 event-committed to prediction-visible latency "
+                  "over recent applied deltas.",
+                  [("", (), float(a["visible_p99_ms"]))]),
+                F("pio_freshness_degraded_total", "counter",
+                  "Answers served with degraded:true because staleness "
+                  "exceeded the freshness SLO.",
+                  [("", (), float(st["degraded_served"]))]),
+            ]
+
+        reg.register_collector(_streaming_families)
 
     # -- batched path: one Algorithm.batch_predict pass for N queries --------
     def _run_query_batch(self, queries: list) -> list:
@@ -734,6 +998,20 @@ class QueryServer:
                     entity_ids_from(data, cache.key_fields),
                     self._serving_gen,
                 )
+        # freshness SLO: when the sealed delta log is ahead of this
+        # replica by more than PIO_FRESHNESS_SLO_MS, the answer is still
+        # served — annotated, never failed.  Runs AFTER cache.put (the
+        # cache deep-copies, so the annotation never sticks to the cached
+        # answer) and applies to hits too: a hot cache entry is exactly as
+        # stale as the factors that computed it.
+        st = self._streaming
+        if st is not None and isinstance(result, dict):
+            stale = self._streaming_staleness_ms()
+            if stale > st["slo_ms"]:
+                result["degraded"] = True
+                result["staleness_ms"] = round(stale, 1)
+                st["degraded_served"] += 1
+                st["wake"].set()
         # plugins see JSON values, as in the reference (JValue-based process)
         for p in self.plugins:
             if p.plugin_type == EngineServerPlugin.OUTPUT_BLOCKER:
@@ -942,6 +1220,28 @@ class QueryServer:
                 plan = (fps.get("sharding") or {}).get("plan") or {}
                 if plan.get("fingerprint"):
                     body["shardingFingerprint"] = plan["fingerprint"]
+            # streaming: expose the applied micro-generation epoch and
+            # current staleness so the router/fleet can see exactly where
+            # this replica sits in the delta sequence
+            st = self._streaming
+            delta_behind = False
+            if st is not None:
+                applied = st["applier"].applied_epoch
+                head = st["log"].last_epoch()
+                body["deltaEpoch"] = applied
+                body["deltaLogEpoch"] = head
+                body["stalenessMs"] = round(self._streaming_staleness_ms(), 1)
+                # a wedged log (torn blob / fence refusal with no progress
+                # since) must not hold the replica out forever: it rejoins
+                # at its last good epoch and serves degraded instead
+                wedged = st.get("wedged")
+                stuck = (
+                    wedged is not None
+                    and applied <= int(wedged.get("applied_epoch", -1))
+                )
+                if stuck:
+                    body["deltaWedged"] = wedged.get("reason")
+                delta_behind = head > applied and not stuck
             # every not-ready answer carries Retry-After, as the shed paths
             # do — docs/operations.md promises the header on all 503s
             retry = {"Retry-After": f"{self.retry_after_s():g}"}
@@ -950,6 +1250,12 @@ class QueryServer:
                 return Response(status=503, body=body, headers=retry)
             if not deployed:
                 body["status"] = "no engine instance deployed"
+                return Response(status=503, body=body, headers=retry)
+            if delta_behind:
+                # catch-up before readmission: wake the worker and refuse
+                # traffic until this replica reaches the fleet's epoch
+                st["wake"].set()
+                body["status"] = "delta catch-up"
                 return Response(status=503, body=body, headers=retry)
             if inflight >= self.max_inflight:
                 body["status"] = "overloaded"
@@ -1014,6 +1320,43 @@ class QueryServer:
         def reload_route(req: Request):
             iid = self.reload()
             return json_response(200, {"message": "Reloaded", "engineInstanceId": iid})
+
+        @svc.route("POST", r"/delta")
+        def delta_route(req: Request):
+            # router → replica delta hop: body is the sealed checksum
+            # envelope, verbatim.  Every answer is a receipt the router
+            # records as this replica's apply acknowledgement.  A torn or
+            # forged payload is an integrity REFUSAL (200 + receipt), not
+            # a 5xx — the replica keeps serving its last good epoch.
+            st = self._streaming
+            if st is None:
+                return json_response(
+                    409,
+                    {"refused": True, "reason": "streaming disabled",
+                     "streaming": _delta.streaming_enabled()},
+                )
+            try:
+                payload = open_model_blob(req.body)
+                dl = _delta.Delta.from_payload(payload)
+            except Exception as e:
+                # legacy passthrough means garbage survives the envelope
+                # check and dies at unpickle — either way it never reaches
+                # the factors
+                receipt = st["applier"].refuse("integrity", error=str(e))
+                return json_response(200, receipt)
+            receipt = st["applier"].apply(dl)
+            if receipt.get("applied"):
+                st["wedged"] = None
+            return json_response(200, receipt)
+
+        @svc.route("GET", r"/delta/stats")
+        def delta_stats_route(req: Request):
+            stats = self.streaming_stats()
+            if stats is None:
+                return json_response(
+                    404, {"message": "streaming disabled"}
+                )
+            return json_response(200, stats)
 
         @svc.route("POST", r"/stop")
         def stop_route(req: Request):
@@ -1120,6 +1463,7 @@ class QueryServer:
         return abandoned == 0
 
     def stop(self) -> None:
+        self._stop_streaming()
         if self._batcher is not None:
             self._batcher.stop()
         if self._feedback_worker is not None:

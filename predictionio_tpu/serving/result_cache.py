@@ -161,6 +161,20 @@ def notify_event(event: Any) -> None:
         INVALIDATIONS.bump_all()
 
 
+def notify_delta(user_ids: Iterable[Any]) -> int:
+    """Streaming micro-generation hook: a sealed delta touched these users.
+
+    Delta apply rewrites factor rows for a *known* set of users, so the
+    invalidation is entity-targeted — every other entity's cached answer
+    stays hot (a full flush here would turn each micro-generation into a
+    cache stampede, defeating the freshness pipeline's latency win).
+    """
+    ids = [str(u) for u in user_ids if u is not None and str(u)]
+    if ids:
+        INVALIDATIONS.bump_entities(ids)
+    return len(ids)
+
+
 def notify_delete() -> None:
     """Event deletion hook: the deleted row's entity is unknown by the
     time the DELETE returns, so invalidate globally (deletes are rare)."""

@@ -95,6 +95,7 @@ class ReplicaState:
         self.ewma_ms: Optional[float] = None
         self.samples = 0
         self.generation: Optional[int] = None
+        self.delta_epoch: Optional[int] = None
         self.warm = True
         self.no_readmit_before = 0.0
         self.last_error = ""
@@ -193,6 +194,11 @@ class Router:
             "ejections_health", "ejections_outlier", "readmissions",
         )
         self._rl_log = RateLimitedLogger(logger)
+        # streaming delta propagation acks by outcome (push_delta); a
+        # plain dict guarded by _lock — outcomes come from receipt shapes,
+        # not a fixed counter list
+        self._delta_propagated = {"applied": 0, "noop": 0, "refused": 0,
+                                  "error": 0}
         self.service = HttpService("router")
         self.telemetry = (
             obs.Telemetry("router").install(self.service)
@@ -375,6 +381,75 @@ class Router:
         except urllib.error.HTTPError as e:
             data = e.read()
             return e.code, data, dict(e.headers or {})
+
+    # -- streaming delta propagation -----------------------------------------
+    def push_delta(
+        self, payload: bytes, deadline: Optional[Deadline] = None
+    ) -> dict:
+        """Propagate one sealed delta blob to EVERY replica's ``POST
+        /delta`` and collect per-replica apply acknowledgements.
+
+        All replicas are pushed — ejected and draining included: a
+        replica that misses the push is not wrong, merely stale, and its
+        own catch-up from the sealed log (gated by /readyz) must close
+        the gap before readmission.  A transport failure or 5xx becomes
+        an ``{"error": ...}`` ack; the push itself never raises.
+        """
+        with self._lock:
+            reps = list(self._replicas)
+        acks = {}
+        applied = 0
+        for rep in reps:
+            receipt = self._push_delta_one(rep, payload, deadline)
+            acks[rep.url] = receipt
+            if receipt.get("applied") or receipt.get("noop"):
+                applied += 1
+            outcome = (
+                "applied" if receipt.get("applied")
+                else "noop" if receipt.get("noop")
+                else "refused" if receipt.get("refused")
+                else "error"
+            )
+            with self._lock:
+                self._delta_propagated[outcome] += 1
+        return {"replicas": len(reps), "acked": applied, "acks": acks}
+
+    def _push_delta_one(
+        self, rep: ReplicaState, payload: bytes,
+        deadline: Optional[Deadline] = None,
+    ) -> dict:
+        """One router→replica delta hop.  Any failure — injected tear,
+        refused connect, 5xx — is shaped into an error ack so the caller
+        always gets one receipt per replica."""
+        headers = {"Content-Type": "application/octet-stream"}
+        timeout = self.request_timeout_s
+        if deadline is not None:
+            # same contract as _forward: forward the budget REMAINING NOW
+            remaining_ms = deadline.remaining_ms()
+            headers[DEADLINE_HEADER] = f"{remaining_ms:.0f}"
+            timeout = min(timeout, max(remaining_ms, 1.0) / 1e3)
+        act = _faults.check("client:replica:delta")
+        if act is not None:
+            if act.latency_s:
+                time.sleep(act.latency_s)
+            if act.kind == "drop":
+                return {"error": "injected drop on router->replica "
+                                 "delta hop"}
+            if act.kind == "error":
+                return {"error": f"injected {act.status} on delta hop"}
+        req = urllib.request.Request(
+            rep.url + "/delta", data=payload, method="POST", headers=headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read().decode("utf-8"))
+            except (ValueError, OSError):
+                return {"error": f"http {e.code}"}
+        except (OSError, ValueError) as e:
+            return {"error": f"{type(e).__name__}: {e}"}
 
     # -- attempt threads -----------------------------------------------------
     def _spawn_attempt(self, slot, rep, body, deadline, hedged, trace_id):
@@ -609,6 +684,9 @@ class Router:
             gen = info.get("generation")
             if isinstance(gen, int):
                 rep.generation = gen
+            de = info.get("deltaEpoch")
+            if isinstance(de, int):
+                rep.delta_epoch = de
             rep.warm = bool(info.get("fastpathWarm", True))
         if ok:
             rep.healthy_streak += 1
@@ -719,6 +797,7 @@ class Router:
                     ),
                     "ewmaMs": r.ewma_ms,
                     "generation": r.generation,
+                    "deltaEpoch": r.delta_epoch,
                     "warm": r.warm,
                     "lastError": r.last_error or None,
                     "breaker": r.breaker.stats(),
@@ -740,6 +819,7 @@ class Router:
                 "budgetTokens": self.budget.tokens(),
             },
             "rolling": rolling,
+            "deltaPropagated": dict(self._delta_propagated),
         }
 
     def _resilience_stats(self) -> dict:
@@ -769,6 +849,7 @@ class Router:
                     for r in self._replicas
                 ]
                 hedge_delay = self._hedge_delay_ms
+                propagated = dict(self._delta_propagated)
             snap = self.counters.snapshot()
             F = _bridges.Family
             lbl = [(("replica", url),) for url, *_ in reps]
@@ -832,6 +913,11 @@ class Router:
                   "Current hedge trigger delay (rolling latency "
                   "quantile, floored at PIO_HEDGE_MIN_MS).",
                   [("", (), float(hedge_delay))]),
+                F("pio_delta_propagated_total", "counter",
+                  "Per-replica delta push acknowledgements by outcome "
+                  "(applied, noop, refused, error).",
+                  [("", (("outcome", k),), float(v))
+                   for k, v in sorted(propagated.items())]),
             ]
 
         reg.register_collector(_router_families)

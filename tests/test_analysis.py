@@ -484,6 +484,53 @@ def test_blocking_positives_and_negatives(tmp_path):
                     "probe_select.sleep"}
 
 
+DELTA_LOOP_FIXTURE = {
+    # the event server's delta flush worker and the replica's catch-up
+    # worker are hot-loop names: pacing belongs on Event.wait, real I/O
+    # in delegated helpers
+    "data/api/delta_flush.py": """\
+        import json
+        import time
+
+        class Publisher:
+            def _delta_loop(self):
+                time.sleep(0.25)
+                return json.dumps({"epoch": 1})
+
+            def _flush_once(self):
+                # delegated helper: not a hot-loop name, out of scope
+                return json.dumps({"epoch": 1})
+    """,
+    "serving/delta_catchup.py": """\
+        class Replica:
+            def _catchup_loop(self):
+                # repo idiom: pace on the sanctioned Event.wait and
+                # delegate the actual log replay — must stay clean
+                while not self._stop.is_set():
+                    self._wake.wait(1.0)
+                    self._wake.clear()
+                    self._catch_up_once()
+
+            def _catch_up_once(self):
+                return 0
+    """,
+    "core/delta_worker.py": """\
+        import time
+
+        class Log:
+            def _delta_loop(self):
+                time.sleep(0.01)  # not serving//data/api: out of scope
+    """,
+}
+
+
+def test_blocking_delta_worker_loops(tmp_path):
+    root = make_repo(tmp_path, DELTA_LOOP_FIXTURE)
+    rep = run(root, analyzers=["blocking"])
+    syms = symbols(rep, "blocking-call-in-hot-loop")
+    assert syms == {"_delta_loop.sleep", "_delta_loop.dumps"}
+
+
 # -- lockorder ----------------------------------------------------------------
 
 
@@ -645,6 +692,45 @@ def test_deadline_submit_must_forward_in_hand_deadline(tmp_path):
     })
     rep = run(root, analyzers=["deadline"])
     assert symbols(rep, "deadline-not-forwarded") == {"handle_batch.submit"}
+
+
+DELTA_DEADLINE_FIXTURE = {
+    # the streaming delta plane: push_delta (router propagation hop)
+    # and catchup (replica log-replay worker) are request entry verbs
+    "serving/delta_push.py": """\
+        import urllib.request
+
+        def push_delta(payload):
+            # outbound hop with no deadline contract: must flag
+            return urllib.request.urlopen("http://replica/delta", timeout=5)
+
+        def push_delta_fenced(payload, deadline):
+            headers = {}
+            headers[DEADLINE_HEADER] = f"{deadline.remaining_ms():.0f}"
+            return urllib.request.urlopen("http://replica/delta", timeout=1)
+    """,
+    "serving/delta_catchup.py": """\
+        import urllib.request
+
+        def catchup_from_log(url):
+            # catch-up fetch without the contract: must flag
+            return urllib.request.urlopen(url, timeout=5)
+    """,
+    "core/delta_core.py": """\
+        import urllib.request
+
+        def push_delta_local(payload):
+            # not a serving/data layer: control plane, out of scope
+            return urllib.request.urlopen("http://x/", timeout=5)
+    """,
+}
+
+
+def test_deadline_delta_plane_entry_points(tmp_path):
+    root = make_repo(tmp_path, DELTA_DEADLINE_FIXTURE)
+    rep = run(root, analyzers=["deadline"])
+    drops = symbols(rep, "deadline-drop")
+    assert drops == {"push_delta", "catchup_from_log"}
 
 
 # -- collective ---------------------------------------------------------------

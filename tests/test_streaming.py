@@ -1,0 +1,918 @@
+"""Streaming micro-generations: crash-safe exactly-once delta pipeline.
+
+Four layers of evidence, mirroring the durability suite's structure:
+
+* delta-log / applier unit tests — epoch fencing, idempotent replay,
+  gap catch-up, torn-blob refusal, the fold-in quality quarantine
+  (pure host + filesystem, no server).
+* exact-equality property test — base model + N sequential deltas
+  (full-fidelity settings: full per-user histories, gate off) ranks
+  identically to folding the same events into a fresh in-memory model.
+* live-server integration — a trained QueryServer applies sealed deltas
+  over HTTP in place (no recompiles), annotates SLO-stale answers with
+  ``degraded:true`` instead of failing, refuses torn blobs with a
+  receipt, catches up from the sealed log before ``/readyz`` readmits
+  it, and with ``PIO_STREAMING=0`` exposes no delta surface at all.
+* kill-9 chaos (``@pytest.mark.chaos``) — subprocesses die at the
+  compiled-in ``crash:delta:*`` sites with ``os._exit(137)`` and fresh
+  processes prove the exactly-once story: the event server regrows the
+  identical delta from WAL replay (zero acked-event loss), the replica
+  catches up from the sealed log and rejoins at the fleet's epoch.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import delta as delta_mod
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.models.als import ALSConfig, ALSModel, fold_in_users
+
+CRASH_RC = 137  # faults.CRASH_EXIT_CODE — 128 + SIGKILL
+
+
+def tiny_model(rank=4, n_users=12, n_items=10, seed=7):
+    """Deterministic base generation: same seed ⇒ same fingerprint, so
+    a crashed process and its restarted verifier agree on the log dir."""
+    rng = np.random.default_rng(seed)
+    return ALSModel(
+        user_factors=rng.standard_normal((n_users, rank)).astype(np.float32),
+        item_factors=rng.standard_normal((n_items, rank)).astype(np.float32),
+        user_map=BiMap.string_int([f"u{i}" for i in range(n_users)]),
+        item_map=BiMap.string_int([f"i{i}" for i in range(n_items)]),
+        config=ALSConfig(rank=rank, iterations=1),
+    )
+
+
+class Ev:
+    """Committed-event shape the publisher sink consumes."""
+
+    def __init__(self, entity_id, target_entity_id, rating=1.0):
+        self.entity_id = entity_id
+        self.target_entity_id = target_entity_id
+        self.properties = {"rating": rating}
+
+
+def publish(model, log_dir, events, **kw):
+    """Seal one micro-generation from `events` and return the receipt."""
+    log = delta_mod.DeltaLog(log_dir)
+    pub = delta_mod.DeltaPublisher(model, log, **kw)
+    pub.on_committed(events)
+    return pub.flush(), pub
+
+
+# -- delta log + applier units ----------------------------------------------
+
+
+class TestDeltaLogApplier:
+    def test_seal_read_roundtrip_monotonic_epochs(self, tmp_path):
+        m = tiny_model()
+        r1, pub = publish(m, str(tmp_path), [Ev("u1", "i2", 5.0)],
+                          min_overlap=0.0)
+        assert r1["sealed"] and r1["epoch"] == 1
+        pub.on_committed([Ev("u3", "i4", 2.0)])
+        r2 = pub.flush()
+        assert r2["sealed"] and r2["epoch"] == 2
+        log = delta_mod.DeltaLog(str(tmp_path))
+        assert log.epochs() == [1, 2]
+        dl = log.read(1)
+        assert dl.epoch == 1
+        assert dl.base_fingerprint == pub.base_fingerprint
+        assert "u1" in dl.user_ids
+        np.testing.assert_equal(
+            dl.user_rows.shape[1], m.config.rank
+        )
+
+    def test_fence_refuses_foreign_base_generation(self, tmp_path):
+        m = tiny_model()
+        _, pub = publish(m, str(tmp_path), [Ev("u1", "i2", 5.0)],
+                         min_overlap=0.0)
+        dl = delta_mod.DeltaLog(str(tmp_path)).read(1)
+        applied = []
+        applier = delta_mod.DeltaApplier(
+            "not-the-base-fingerprint", applied.append
+        )
+        receipt = applier.apply(dl)
+        assert receipt["refused"] and receipt["reason"] == "fingerprint"
+        assert applied == []  # a fenced delta never touches the model
+        assert applier.applied_epoch == 0
+
+    def test_replay_of_applied_epoch_is_idempotent_noop(self, tmp_path):
+        m = tiny_model()
+        _, pub = publish(m, str(tmp_path), [Ev("u1", "i2", 5.0)],
+                         min_overlap=0.0)
+        dl = delta_mod.DeltaLog(str(tmp_path)).read(1)
+        applied = []
+        applier = delta_mod.DeltaApplier(pub.base_fingerprint, applied.append)
+        assert applier.apply(dl)["applied"]
+        assert len(applied) == 1
+        # a retried router push / full log replay changes nothing
+        again = applier.apply(dl)
+        assert again["noop"] and len(applied) == 1
+        assert applier.stats()["noops"] == 1
+
+    def test_gap_triggers_catch_up_from_sealed_log(self, tmp_path):
+        m = tiny_model()
+        _, pub = publish(m, str(tmp_path), [Ev("u1", "i2", 5.0)],
+                         min_overlap=0.0)
+        for ev in ([Ev("u2", "i3", 4.0)], [Ev("u4", "i5", 3.0)]):
+            pub.on_committed(ev)
+            assert pub.flush()["sealed"]
+        log = delta_mod.DeltaLog(str(tmp_path))
+        applied = []
+        applier = delta_mod.DeltaApplier(
+            pub.base_fingerprint, lambda d: applied.append(d.epoch),
+            delta_log=log,
+        )
+        # pushing epoch 3 first: the applier must replay 1 and 2 from the
+        # log before applying it, never skip
+        receipt = applier.apply(log.read(3))
+        assert receipt["applied"]
+        assert applied == [1, 2, 3]
+        assert applier.applied_epoch == 3
+
+    def test_torn_blob_stops_catch_up_at_last_good(self, tmp_path):
+        m = tiny_model()
+        _, pub = publish(m, str(tmp_path), [Ev("u1", "i2", 5.0)],
+                         min_overlap=0.0)
+        pub.on_committed([Ev("u2", "i3", 4.0)])
+        assert pub.flush()["sealed"]
+        log = delta_mod.DeltaLog(str(tmp_path))
+        # tear epoch 2 on disk (external corruption; seal itself is atomic)
+        raw = bytearray(open(log.path(2), "rb").read())
+        raw[-3] ^= 0xFF
+        open(log.path(2), "wb").write(bytes(raw))
+        applied = []
+        applier = delta_mod.DeltaApplier(
+            pub.base_fingerprint, lambda d: applied.append(d.epoch),
+            delta_log=log,
+        )
+        rc = applier.catch_up()
+        assert applied == [1]  # everything before the tear is real
+        assert applier.applied_epoch == 1
+        assert rc["refused"] and rc["reason"] == "integrity"
+
+    def test_quality_gate_quarantines_and_rolls_back(self, tmp_path):
+        m = tiny_model()
+        # an unreachable threshold forces the quarantine path
+        receipt, pub = publish(m, str(tmp_path), [Ev("u1", "i2", 5.0)],
+                               min_overlap=1.1)
+        assert receipt["refused"] and receipt["reason"] == "quality"
+        assert receipt["rolled_back_to"] == 0
+        assert delta_mod.DeltaLog(str(tmp_path)).epochs() == []
+        # the refusal receipt is durable next to the log
+        refusal = json.load(
+            open(os.path.join(str(tmp_path), "refusal-00000001.json"))
+        )
+        assert refusal["reason"] == "quality"
+        assert refusal["overlap"] < refusal["threshold"]
+        # the epoch was not burned: the next good fold-in takes epoch 1
+        pub.min_overlap = 0.0
+        pub.on_committed([Ev("u1", "i2", 5.0)])
+        assert pub.flush()["epoch"] == 1
+
+    def test_log_prune_keeps_newest(self, tmp_path):
+        m = tiny_model()
+        _, pub = publish(m, str(tmp_path), [Ev("u1", "i2", 5.0)],
+                         min_overlap=0.0)
+        for i in range(4):
+            pub.on_committed([Ev(f"u{i + 2}", "i3", 2.0)])
+            assert pub.flush()["sealed"]
+        log = delta_mod.DeltaLog(str(tmp_path))
+        assert log.epochs() == [1, 2, 3, 4, 5]
+        log.prune(keep=2)
+        assert log.epochs() == [4, 5]
+        assert log.last_epoch() == 5
+
+
+# -- exact-equality property -------------------------------------------------
+
+
+class TestExactEquality:
+    def test_base_plus_deltas_equals_fresh_fold(self, tmp_path):
+        """base + N sequential deltas == folding the same events into a
+        fresh in-memory model (same top-k), under full-fidelity settings:
+        the publisher's ``history_fn`` hands each fold the user's FULL
+        event history, so the last delta row per user IS the direct
+        fold-in row."""
+        base = tiny_model(n_users=10, n_items=12, seed=11)
+        histories: dict = {}
+
+        def history_fn(user_id):
+            return list(histories.get(user_id, []))
+
+        pub_model = copy.deepcopy(base)
+        log = delta_mod.DeltaLog(str(tmp_path))
+        pub = delta_mod.DeltaPublisher(
+            pub_model, log, history_fn=history_fn, min_overlap=0.0
+        )
+
+        rng = np.random.default_rng(5)
+        batches = []
+        for _ in range(3):
+            batch = []
+            for _ in range(6):
+                u, i = f"u{rng.integers(10)}", f"i{rng.integers(12)}"
+                r = float(rng.integers(1, 6))
+                histories.setdefault(u, []).append((i, r))
+                batch.append(Ev(u, i, r))
+            batches.append(batch)
+
+        # replica path: apply each sealed delta in place on a copy of base
+        replica = copy.deepcopy(base)
+
+        def apply_fn(dl):
+            replica.user_factors[np.asarray(dl.user_idx)] = dl.user_rows
+
+        applier = delta_mod.DeltaApplier(
+            pub.base_fingerprint, apply_fn, delta_log=log
+        )
+        touched = set()
+        for epoch, batch in enumerate(batches, start=1):
+            # rebuild histories incrementally: batch k folds with the
+            # history known at seal time (already accumulated above, so
+            # re-feed only this batch's events to the publisher)
+            pub.on_committed(batch)
+            receipt = pub.flush()
+            assert receipt["sealed"] and receipt["epoch"] == epoch
+            assert applier.apply(log.read(epoch))["applied"]
+            touched |= {e.entity_id for e in batch}
+
+        # reference path: fold the SAME merged histories into a fresh copy
+        fresh = copy.deepcopy(base)
+        cfg = fresh.config
+        interactions = {}
+        for u, pairs in histories.items():
+            uidx = fresh.user_map[u]
+            interactions[uidx] = [
+                (fresh.item_map[i], r) for i, r in pairs
+            ]
+        user_idx = np.array(sorted(interactions), dtype=np.int32)
+        rows = fold_in_users(
+            fresh.item_factors,
+            {u: interactions[u] for u in user_idx},
+            rank=cfg.rank, reg=cfg.reg, implicit=cfg.implicit,
+            alpha=cfg.alpha, compute_dtype=cfg.compute_dtype,
+        )
+        fresh.user_factors[user_idx] = rows
+
+        V = base.item_factors
+        for u in sorted(touched):
+            uidx = base.user_map[u]
+            got = np.argsort(-(replica.user_factors[uidx] @ V.T))[:5]
+            want = np.argsort(-(fresh.user_factors[uidx] @ V.T))[:5]
+            np.testing.assert_array_equal(got, want)
+            np.testing.assert_allclose(
+                replica.user_factors[uidx], fresh.user_factors[uidx],
+                rtol=1e-6, atol=1e-6,
+            )
+
+
+# -- result-cache entity-targeted invalidation -------------------------------
+
+
+class TestResultCacheDeltaInvalidation:
+    def test_delta_touching_user_a_leaves_user_b_hot(self):
+        from predictionio_tpu.serving import result_cache as rc
+
+        cache = rc.ResultCache(ttl_s=300.0)
+        cache.put("fpA", {"itemScores": [{"item": "i1"}]}, ("uA",), 0)
+        cache.put("fpB", {"itemScores": [{"item": "i2"}]}, ("uB",), 0)
+        assert cache.get("fpA", 0) is not None
+        assert cache.get("fpB", 0) is not None
+
+        assert rc.notify_delta(["uA"]) == 1
+
+        # user A's answer died with the delta; user B's stayed hot
+        assert cache.get("fpA", 0) is None
+        assert cache.get("fpB", 0) is not None
+        st = cache.stats()
+        assert st["invalidated_event"] == 1
+
+    def test_notify_delta_ignores_empty_ids(self):
+        from predictionio_tpu.serving import result_cache as rc
+
+        cache = rc.ResultCache(ttl_s=300.0)
+        cache.put("fpC", {"itemScores": []}, ("uC",), 0)
+        assert rc.notify_delta([None, ""]) == 0
+        assert cache.get("fpC", 0) is not None  # never a global flush
+
+
+# -- live-server integration -------------------------------------------------
+
+
+def call(method, url, body=None, raw=None):
+    ctype = "application/octet-stream" if raw is not None \
+        else "application/json"
+    data = raw if raw is not None else (
+        json.dumps(body).encode() if body is not None else None
+    )
+    req = urllib.request.Request(
+        url, data=data, method=method, headers={"Content-Type": ctype}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+@pytest.fixture()
+def trained_streaming(storage, tmp_path, monkeypatch):
+    """A trained engine + streaming env: PIO_STREAMING=1, a pinned delta
+    dir, and a catch-up pace slow enough that every apply in the tests
+    is driven by an explicit wake (deterministic ordering)."""
+    monkeypatch.setenv("PIO_STREAMING", "1")
+    monkeypatch.setenv("PIO_DELTA_DIR", str(tmp_path / "deltas"))
+    monkeypatch.setenv("PIO_DELTA_CATCHUP_MS", "60000")
+
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import Event, store as store_mod
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.parallel.mesh import MeshContext
+    from predictionio_tpu.templates.recommendation import (
+        RecommendationEngine,
+    )
+
+    store_mod.set_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(App(0, "streamapp"))
+    le = storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(3)
+    events = []
+    for u in range(20):
+        for i in rng.choice(16, size=6, replace=False):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties={"rating": float(rng.integers(1, 6))},
+            ))
+    le.batch_insert(events, app_id)
+    engine = RecommendationEngine.apply()
+    ep = engine.params_from_variant({
+        "datasource": {"params": {"appName": "streamapp"}},
+        "algorithms": [
+            {"name": "als", "params": {"rank": 4, "numIterations": 3}}
+        ],
+    })
+    ctx = MeshContext.create()
+    run_train(engine, ep, "f", storage=storage, ctx=ctx)
+    yield {"storage": storage, "engine": engine, "ctx": ctx}
+    store_mod.set_storage(None)
+
+
+def make_server(trained):
+    from predictionio_tpu.serving.query_server import QueryServer
+
+    return QueryServer(
+        trained["engine"], storage=trained["storage"], ctx=trained["ctx"]
+    )
+
+
+class TestStreamingServer:
+    def test_streaming_lifecycle_over_http(self, trained_streaming):
+        qs = make_server(trained_streaming)
+        st = qs._streaming
+        assert st is not None
+        port = qs.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            status, rz = call("GET", base + "/readyz")
+            assert status == 200 and rz["deltaEpoch"] == 0
+
+            status, before = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200
+
+            # the event plane's publisher: its own copy of the same base
+            pub_model = copy.deepcopy(st["model"])
+            log = delta_mod.DeltaLog(st["dir"])
+            pub = delta_mod.DeltaPublisher(pub_model, log, min_overlap=0.0)
+            assert pub.base_fingerprint == st["fingerprint"]
+            pub.on_committed([Ev("u1", "i3", 5.0), Ev("u1", "i7", 5.0)])
+            receipt = pub.flush()
+            assert receipt["sealed"] and receipt["epoch"] == 1
+
+            blob = open(log.path(1), "rb").read()
+            status, ack = call("POST", base + "/delta", raw=blob)
+            assert status == 200 and ack["applied"] and ack["epoch"] == 1
+
+            # exactly-once: a retried push acks as a no-op
+            status, ack2 = call("POST", base + "/delta", raw=blob)
+            assert status == 200 and ack2["noop"]
+
+            status, rz = call("GET", base + "/readyz")
+            assert status == 200 and rz["deltaEpoch"] == 1
+
+            # the in-place row patch is live: u1 still answers, and the
+            # scorer served it without a recompile (same process, same
+            # bucket shapes)
+            status, after = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200 and len(after["itemScores"]) == 3
+            scorer = getattr(st["algo"], "_fastpath", None)
+            if scorer is not None:
+                compiles_before = scorer.compile_count
+                status, _ = call(
+                    "POST", base + "/queries.json", {"user": "u2", "num": 3}
+                )
+                assert status == 200
+                assert scorer.compile_count == compiles_before
+
+            # torn blob → integrity refusal receipt; serving keeps going
+            status, bad = call(
+                "POST", base + "/delta", raw=b"PIOM1" + b"garbage" * 3
+            )
+            assert status == 200 and bad["refused"]
+            assert bad["reason"] == "integrity"
+            status, _ = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200
+
+            # fence: a delta from a DIFFERENT base generation is refused
+            foreign = tiny_model(rank=4, n_users=20, n_items=16, seed=99)
+            fdir = os.path.join(st["dir"], "..", "foreign")
+            _, fpub = publish(
+                foreign, fdir, [Ev("u1", "i1", 5.0)], min_overlap=0.0
+            )
+            fblob = open(delta_mod.DeltaLog(fdir).path(1), "rb").read()
+            status, fref = call("POST", base + "/delta", raw=fblob)
+            assert status == 200 and fref["refused"]
+            assert fref["reason"] == "fingerprint"
+
+            # SLO breach: seal epoch 2 but don't push; the next answer is
+            # served degraded (annotated, never failed) and wakes catch-up
+            pub.on_committed([Ev("u3", "i2", 4.0)])
+            assert pub.flush()["epoch"] == 2
+            st["slo_ms"] = 0.0
+            st["staleness_checked"] = 0.0
+            time.sleep(0.05)
+            status, res = call(
+                "POST", base + "/queries.json", {"user": "u2", "num": 3}
+            )
+            assert status == 200
+            assert res.get("degraded") is True and "staleness_ms" in res
+
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    st["applier"].applied_epoch < 2:
+                time.sleep(0.05)
+            assert st["applier"].applied_epoch == 2
+
+            # metric families are live on /metrics
+            with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+                text = r.read().decode()
+            for fam in ("pio_delta_epoch", "pio_delta_refused_total",
+                        "pio_freshness_staleness_ms",
+                        "pio_freshness_degraded_total"):
+                assert fam in text
+        finally:
+            qs.stop()
+
+    def test_catch_up_gates_readmission(self, trained_streaming):
+        qs = make_server(trained_streaming)
+        st = qs._streaming
+        port = qs.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            pub_model = copy.deepcopy(st["model"])
+            log = delta_mod.DeltaLog(st["dir"])
+            pub = delta_mod.DeltaPublisher(pub_model, log, min_overlap=0.0)
+            pub.on_committed([Ev("u4", "i1", 5.0)])
+            assert pub.flush()["sealed"]
+
+            # behind the log: /readyz answers 503 "delta catch-up" (the
+            # router's health gate keeps the replica ejected) AND wakes
+            # the catch-up worker
+            status, rz = call("GET", base + "/readyz")
+            if status == 503:
+                assert rz["status"] == "delta catch-up"
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    st["applier"].applied_epoch < 1:
+                time.sleep(0.05)
+            assert st["applier"].applied_epoch == 1
+            status, rz = call("GET", base + "/readyz")
+            assert status == 200 and rz["deltaEpoch"] == 1
+        finally:
+            qs.stop()
+
+    def test_restarted_replica_rejoins_at_log_epoch(self, trained_streaming):
+        # seal two epochs first, then "restart": a fresh server's
+        # synchronous catch-up in enable_streaming runs BEFORE /readyz can
+        # answer ready, so it rejoins at the fleet's epoch, never behind
+        qs = make_server(trained_streaming)
+        st = qs._streaming
+        pub_model = copy.deepcopy(st["model"])
+        log = delta_mod.DeltaLog(st["dir"])
+        pub = delta_mod.DeltaPublisher(pub_model, log, min_overlap=0.0)
+        for ev in ([Ev("u5", "i2", 5.0)], [Ev("u6", "i3", 1.0)]):
+            pub.on_committed(ev)
+            assert pub.flush()["sealed"]
+        qs.stop()
+
+        qs2 = make_server(trained_streaming)
+        try:
+            st2 = qs2._streaming
+            assert st2["applier"].applied_epoch == 2
+            assert st2["applier"].stats()["applied"] == 2
+        finally:
+            qs2.stop()
+
+    def test_wedged_replica_serves_degraded_not_503(self, trained_streaming):
+        qs = make_server(trained_streaming)
+        st = qs._streaming
+        port = qs.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            pub_model = copy.deepcopy(st["model"])
+            log = delta_mod.DeltaLog(st["dir"])
+            pub = delta_mod.DeltaPublisher(pub_model, log, min_overlap=0.0)
+            pub.on_committed([Ev("u7", "i4", 3.0)])
+            assert pub.flush()["sealed"]
+            # tear the only sealed blob: catch-up can never make progress
+            raw = bytearray(open(log.path(1), "rb").read())
+            raw[-3] ^= 0xFF
+            open(log.path(1), "wb").write(bytes(raw))
+
+            deadline = time.time() + 10
+            wedged = False
+            while time.time() < deadline:
+                status, rz = call("GET", base + "/readyz")
+                if status == 200 and rz.get("deltaWedged"):
+                    wedged = True
+                    break
+                time.sleep(0.1)
+            assert wedged, "permanently torn blob must not 503-wedge"
+            # still serving, on the last-good epoch
+            status, res = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200 and len(res["itemScores"]) == 3
+            assert st["applier"].applied_epoch == 0
+        finally:
+            qs.stop()
+
+    def test_streaming_off_is_invisible(self, trained_streaming,
+                                        monkeypatch):
+        monkeypatch.setenv("PIO_STREAMING", "0")
+        qs = make_server(trained_streaming)
+        assert qs._streaming is None
+        port = qs.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            status, rz = call("GET", base + "/readyz")
+            assert status == 200 and "deltaEpoch" not in rz
+            status, ref = call("POST", base + "/delta", raw=b"anything")
+            assert status == 409 and ref["refused"]
+            status, res = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200
+            assert "degraded" not in res and "staleness_ms" not in res
+            with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+                text = r.read().decode()
+            assert "pio_delta_" not in text
+            assert "pio_freshness_" not in text
+        finally:
+            qs.stop()
+
+
+# -- event-server publisher + router propagation -----------------------------
+
+
+class TestEventServerPublisher:
+    def test_attach_replays_events_committed_before_enable(
+        self, storage, tmp_path, monkeypatch
+    ):
+        """The no-acked-event-loss attach contract: events committed
+        before the publisher exists (WAL replay runs in ``__init__``)
+        reach it through the bounded ring on attach."""
+        monkeypatch.setenv("PIO_STREAMING", "1")
+        monkeypatch.setenv("PIO_DELTA_FLUSH_MS", "60000")
+        from predictionio_tpu.data.api.event_server import EventServer
+
+        es = EventServer(storage=storage, telemetry=False)
+        try:
+            model = tiny_model()
+            # committed before any publisher is attached
+            es._notify_committed([Ev("u1", "i2", 5.0), Ev("u3", "i4", 2.0)])
+            pub = es.enable_delta_publisher(
+                model, delta_dir=str(tmp_path / "log"), min_overlap=0.0
+            )
+            assert pub is not None
+            assert pub.pending() == 2  # ring replay fed the backlog
+            es._delta_flush_once()
+            st = pub.stats()
+            assert st["sealed"] == 1 and st["log_epoch"] == 1
+            dl = delta_mod.DeltaLog(str(tmp_path / "log")).read(1)
+            assert set(dl.user_ids) == {"u1", "u3"}
+        finally:
+            es.stop()
+
+    def test_publisher_is_noop_when_streaming_off(self, storage, tmp_path):
+        from predictionio_tpu.data.api.event_server import EventServer
+
+        assert os.environ.get("PIO_STREAMING", "0") != "1"
+        es = EventServer(storage=storage, telemetry=False)
+        try:
+            assert es.enable_delta_publisher(
+                tiny_model(), delta_dir=str(tmp_path)
+            ) is None
+            assert es._recent_committed is None
+        finally:
+            es.stop()
+
+
+class TestRouterDeltaPropagation:
+    def test_push_delta_collects_acks_and_faults_shape_errors(
+        self, trained_streaming
+    ):
+        from predictionio_tpu.common import faults
+        from predictionio_tpu.serving.router import Router
+
+        qs = make_server(trained_streaming)
+        st = qs._streaming
+        port = qs.start("127.0.0.1", 0)
+        url = f"http://127.0.0.1:{port}"
+        router = Router([url], telemetry=False)
+        try:
+            pub_model = copy.deepcopy(st["model"])
+            log = delta_mod.DeltaLog(st["dir"])
+            pub = delta_mod.DeltaPublisher(pub_model, log, min_overlap=0.0)
+            pub.on_committed([Ev("u8", "i5", 4.0)])
+            assert pub.flush()["sealed"]
+            blob = open(log.path(1), "rb").read()
+
+            out = router.push_delta(blob)
+            assert out["replicas"] == 1 and out["acked"] == 1
+            assert out["acks"][url]["applied"]
+            # retried propagation is an acknowledged no-op fleet-wide
+            out2 = router.push_delta(blob)
+            assert out2["acked"] == 1 and out2["acks"][url]["noop"]
+
+            # inject a tear on the router→replica delta hop: the push
+            # never raises, the ack is shaped into an error, and the
+            # replica (which missed the delta) catches up from the log
+            pub.on_committed([Ev("u9", "i6", 2.0)])
+            assert pub.flush()["epoch"] == 2
+            blob2 = open(log.path(2), "rb").read()
+            faults.install(faults.FaultPlan([faults.FaultRule(
+                site="client:replica:delta", kind="drop", times=1
+            )]))
+            try:
+                out3 = router.push_delta(blob2)
+            finally:
+                faults.clear()
+            assert out3["acked"] == 0
+            assert "error" in out3["acks"][url]
+            stats = router.stats()
+            assert stats["deltaPropagated"]["applied"] == 1
+            assert stats["deltaPropagated"]["noop"] == 1
+            assert stats["deltaPropagated"]["error"] == 1
+            # the missed replica closes the gap from the sealed log
+            assert st["applier"].applied_epoch == 1
+            rc = st["applier"].catch_up()
+            assert rc["caught_up"] == 1
+            assert st["applier"].applied_epoch == 2
+        finally:
+            router.stop()
+            qs.stop()
+
+
+# -- kill-9 chaos (subprocess) -----------------------------------------------
+
+
+def run_py(code, env, timeout=60):
+    return subprocess.run(
+        [sys.executable, "-c", code], env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+# deterministic model shared by a crashing process and its verifier
+MODEL_SRC = """
+import numpy as np
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.models.als import ALSConfig, ALSModel
+
+def tiny_model(rank=4, n_users=12, n_items=10, seed=7):
+    rng = np.random.default_rng(seed)
+    return ALSModel(
+        user_factors=rng.standard_normal((n_users, rank)).astype(np.float32),
+        item_factors=rng.standard_normal((n_items, rank)).astype(np.float32),
+        user_map=BiMap.string_int([f"u{i}" for i in range(n_users)]),
+        item_map=BiMap.string_int([f"i{i}" for i in range(n_items)]),
+        config=ALSConfig(rank=rank, iterations=1),
+    )
+"""
+
+
+@pytest.fixture()
+def chaos_env(tmp_path):
+    src = "SCHAOS"
+    env = dict(os.environ)
+    for k in ("PIO_FAULT_SPEC", "PIO_INGEST_BUFFER", "PIO_DELTA_DIR",
+              "PIO_STREAMING"):
+        env.pop(k, None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        f"PIO_STORAGE_SOURCES_{src}_TYPE": "sqlite",
+        f"PIO_STORAGE_SOURCES_{src}_PATH": str(tmp_path / "events.sqlite"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+        "PIO_WAL_DIR": str(tmp_path / "wal"),
+        "PIO_STREAMING": "1",
+        "PIO_DELTA_DIR": str(tmp_path / "deltas"),
+        "PIO_DELTA_FLUSH_MS": "60000",
+        "CHAOS_ACKED_FILE": str(tmp_path / "acked.txt"),
+        "CHAOS_APPLIED_FILE": str(tmp_path / "applied.txt"),
+    })
+    return env
+
+
+SEAL_CRASH = MODEL_SRC + """
+import os, time
+from predictionio_tpu.data.api.event_server import EventServer
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.registry import Storage
+
+storage = Storage()
+storage.get_l_events().init(1)
+es = EventServer(storage=storage, ingest_mode="fast",
+                 wal_dir=os.environ["PIO_WAL_DIR"],
+                 ingest_flush_ms=300.0, telemetry=False)
+# max_events=8: the 8th committed event triggers the publisher's inline
+# flush DURING the group-commit on_commit hook — i.e. after the events
+# are WAL-acked but before wal.commit reclaims their journal records
+pub = es.enable_delta_publisher(tiny_model(), min_overlap=0.0,
+                                max_events=8)
+assert pub is not None
+ack_log = open(os.environ["CHAOS_ACKED_FILE"], "a")
+for i in range(8):
+    e = Event(event="rate", entity_type="user", entity_id=f"u{i}",
+              target_entity_type="item", target_entity_id=f"i{i % 5}",
+              properties={"rating": 5.0}, event_id=f"delta-ev-{i:03d}")
+    es.ingest_buffer.submit(e, 1)  # WAL-journaled before return: acked
+    ack_log.write(e.event_id + "\\n")
+    ack_log.flush()
+    os.fsync(ack_log.fileno())
+# one 300 ms group-commit window coalesces all 8 submits into a single
+# flush: insert -> on_commit -> pending hits 8 -> inline seal ->
+# crash:delta:before_seal kills the process (journal still holds all 8)
+time.sleep(30)
+raise SystemExit("crash site never fired")
+"""
+
+SEAL_VERIFY = MODEL_SRC + """
+import json, os
+from predictionio_tpu.core import delta as delta_mod
+from predictionio_tpu.data.api.event_server import EventServer
+from predictionio_tpu.data.storage.registry import Storage
+
+storage = Storage()
+es = EventServer(storage=storage, ingest_mode="fast",
+                 wal_dir=os.environ["PIO_WAL_DIR"], telemetry=False)
+# WAL replay ran in __init__ and fed the committed-event ring; attaching
+# the publisher now still sees every acked event
+pub = es.enable_delta_publisher(tiny_model(), min_overlap=0.0)
+es._delta_flush_once()
+st = pub.stats()
+ids = sorted(e.event_id for e in storage.get_l_events().find(1))
+dl = delta_mod.DeltaLog(pub.log.directory)
+delta = dl.read(dl.last_epoch()) if dl.last_epoch() else None
+print(json.dumps({
+    "replayed": es.wal_replayed, "ids": ids, "stats": {
+        "sealed": st["sealed"], "log_epoch": st["log_epoch"]},
+    "delta_users": sorted(delta.user_ids) if delta else [],
+}))
+es.stop()
+"""
+
+
+APPLY_CRASH = MODEL_SRC + """
+import os
+from predictionio_tpu.core import delta as delta_mod
+
+model = tiny_model()
+
+class Ev:
+    def __init__(self, e, t, r):
+        self.entity_id, self.target_entity_id = e, t
+        self.properties = {"rating": r}
+
+log_dir = os.environ["CHAOS_DELTA_LOG"]
+log = delta_mod.DeltaLog(log_dir)
+pub = delta_mod.DeltaPublisher(model, log, min_overlap=0.0)
+for ev in ([Ev("u1", "i2", 5.0)], [Ev("u3", "i4", 2.0)]):
+    pub.on_committed(ev)
+    assert pub.flush()["sealed"]
+
+applied = open(os.environ["CHAOS_APPLIED_FILE"], "a")
+
+def apply_fn(dl):
+    applied.write(f"{dl.epoch}\\n")
+    applied.flush()
+    os.fsync(applied.fileno())
+
+applier = delta_mod.DeltaApplier(pub.base_fingerprint, apply_fn,
+                                 delta_log=log)
+applier.catch_up()  # crash:delta:mid_apply kills us BEFORE apply_fn runs
+raise SystemExit("crash site never fired")
+"""
+
+APPLY_VERIFY = MODEL_SRC + """
+import json, os
+from predictionio_tpu.core import delta as delta_mod
+
+model = tiny_model()
+log = delta_mod.DeltaLog(os.environ["CHAOS_DELTA_LOG"])
+fp = delta_mod.model_fingerprint(model.user_factors, model.item_factors)
+applied = open(os.environ["CHAOS_APPLIED_FILE"], "a")
+
+def apply_fn(dl):
+    applied.write(f"{dl.epoch}\\n")
+    applied.flush()
+    os.fsync(applied.fileno())
+
+applier = delta_mod.DeltaApplier(fp, apply_fn, delta_log=log)
+rc = applier.catch_up()
+print(json.dumps({"caught_up": rc.get("caught_up"),
+                  "applied_epoch": applier.applied_epoch,
+                  "log_epoch": log.last_epoch()}))
+"""
+
+
+@pytest.mark.chaos
+class TestStreamingKill9:
+    def test_seal_crash_loses_nothing_delta_regrows_on_replay(
+        self, chaos_env
+    ):
+        """kill -9 between WAL ack and delta seal: zero acked-event loss,
+        and the restarted event server regrows the delta from the same
+        durable events (WAL replay → ring → publisher attach)."""
+        env = dict(chaos_env)
+        env["PIO_FAULT_SPEC"] = (
+            "site=crash:delta:before_seal,kind=crash,times=1"
+        )
+        crash = run_py(SEAL_CRASH, env)
+        assert crash.returncode == CRASH_RC, crash.stderr[-2000:]
+        acked = [
+            line for line in
+            open(env["CHAOS_ACKED_FILE"]).read().splitlines() if line
+        ]
+        assert len(acked) == 8
+        # the crash landed before the seal: no delta blob exists anywhere,
+        # and the un-reclaimed WAL segments still hold every acked event
+        for root, _, files in os.walk(env["PIO_DELTA_DIR"]):
+            assert not [f for f in files if f.startswith("delta-")]
+        assert os.listdir(env["PIO_WAL_DIR"])
+
+        verify = run_py(SEAL_VERIFY, chaos_env)
+        assert verify.returncode == 0, verify.stderr[-2000:]
+        out = json.loads(verify.stdout.strip().splitlines()[-1])
+        assert out["replayed"] >= 8
+        assert set(acked) <= set(out["ids"])  # zero acked-event loss
+        # the identical delta regrew from replayed events: epoch 1, all
+        # eight users folded
+        assert out["stats"]["sealed"] == 1
+        assert out["stats"]["log_epoch"] == 1
+        assert out["delta_users"] == [f"u{i}" for i in range(8)]
+
+    def test_mid_apply_crash_restart_catches_up_to_fleet_epoch(
+        self, chaos_env, tmp_path
+    ):
+        """kill -9 mid-apply: the crash fires before the apply lands, so
+        the restarted replica replays the sealed log from scratch and
+        rejoins at the log head — exactly-once via epoch fencing."""
+        env = dict(chaos_env)
+        env["CHAOS_DELTA_LOG"] = str(tmp_path / "applylog")
+        env["PIO_FAULT_SPEC"] = (
+            "site=crash:delta:mid_apply,kind=crash,times=1"
+        )
+        crash = run_py(APPLY_CRASH, env)
+        assert crash.returncode == CRASH_RC, crash.stderr[-2000:]
+        # died before epoch 1's apply_fn ran: nothing recorded applied
+        assert open(env["CHAOS_APPLIED_FILE"]).read().strip() == ""
+
+        venv = dict(chaos_env)
+        venv["CHAOS_DELTA_LOG"] = env["CHAOS_DELTA_LOG"]
+        verify = run_py(APPLY_VERIFY, venv)
+        assert verify.returncode == 0, verify.stderr[-2000:]
+        out = json.loads(verify.stdout.strip().splitlines()[-1])
+        assert out["caught_up"] == 2
+        assert out["applied_epoch"] == out["log_epoch"] == 2
+        applied = open(venv["CHAOS_APPLIED_FILE"]).read().split()
+        assert applied == ["1", "2"]

@@ -34,7 +34,8 @@ _PIN = {"BENCH_REBALANCE": "1", "BENCH_DTYPE": "f32"}
 _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
          "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0",
          "BENCH_KERNEL": "0", "BENCH_TRAIN_KERNEL": "0", "BENCH_FLEET": "0",
-         "BENCH_ELASTIC": "0", "BENCH_SHARDED": "0", "BENCH_RETRIEVAL": "0"}
+         "BENCH_ELASTIC": "0", "BENCH_SHARDED": "0", "BENCH_RETRIEVAL": "0",
+         "BENCH_FRESHNESS": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -259,6 +260,21 @@ def main() -> int:
         "scale_downs": ela.get("scale_downs"),
         "preemptions": ela.get("preemptions"),
         "gate_pass": ela.get("gate_pass"),
+    }
+    # streaming-freshness gate (ISSUE 17): sustained loadtest ingest with
+    # the autoscaler active — every micro-generation must seal and be
+    # acked by the full fleet, event→prediction-visible p99 must stay
+    # within PIO_FRESHNESS_SLO_MS, and zero fast-acked events may be lost
+    fresh = primary.get("freshness") or {}
+    artifact["freshness"] = {
+        "batches": fresh.get("batches"),
+        "sealed": fresh.get("sealed"),
+        "visible_p99_ms": fresh.get("visible_p99_ms"),
+        "apply_wall_ms": fresh.get("apply_wall_ms"),
+        "slo_ms": fresh.get("slo_ms"),
+        "lost_acked_events": fresh.get("lost_acked_events"),
+        "query_errors": fresh.get("query_errors"),
+        "gate_pass": fresh.get("gate_pass"),
     }
     # sharded-serving gate (ISSUE 12): a catalog sized past one device's
     # (simulated) HBM budget, served partitioned under Zipf load — sharded
