@@ -17,7 +17,10 @@ every hop:
   its live generation (stale publisher, split-brain, mid-roll mixups),
   and re-applying an already-applied epoch is an idempotent no-op —
   exactly-once by construction, kill -9 anywhere in the apply path
-  included.
+  included.  On the fold side, sealed deltas carry the durable ids of
+  the events they folded, and the publisher skips replayed events that
+  already sealed — WAL/ring replay after a clean restart never
+  double-folds (see :class:`DeltaPublisher`).
 * **Quality gate** — fold-in rows are gated on top-k overlap against a
   full-fidelity reference solve on sampled users
   (``PIO_DELTA_MIN_OVERLAP``, the streaming analogue of the
@@ -41,6 +44,7 @@ platform behaves bit-identically to full-retrain-only serving.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import hashlib
 import json
@@ -63,6 +67,11 @@ log = logging.getLogger("pio.delta")
 
 DELTA_PAYLOAD_VERSION = 1
 _DELTA_RE = re.compile(r"^delta-(\d{8})\.blob$")
+
+# Bound on the publisher's folded-event-id dedupe window.  WAL replay
+# and the committed-event ring only ever re-deliver *recent* events, so
+# the window needs to cover a few retention-worths of folds, not history.
+_DEDUP_KEEP = 65536
 
 
 def streaming_enabled() -> bool:
@@ -124,6 +133,11 @@ class Delta:
     user-side fold-in — are routed to their owning shard through the
     ShardingPlan by the fastpath apply.  ``cooc_updates`` is an (m, 3)
     int64 array of ``(item_a, item_b, +count)`` pair increments.
+    ``event_ids`` records the durable ids of the committed events folded
+    in — the sealed log doubles as the publisher's folded-event
+    high-water record, so a restarted publisher skips WAL/ring-replayed
+    events that already sealed into a prior epoch instead of folding
+    them twice.
     """
 
     epoch: int
@@ -137,6 +151,7 @@ class Delta:
     events: int  # committed events folded into this delta
     created_unix: float
     quality: dict  # gate receipt: {"overlap": .., "threshold": ..}
+    event_ids: tuple = ()  # durable ids of the folded events (dedupe fence)
 
     def to_payload(self) -> bytes:
         return pickle.dumps({
@@ -152,6 +167,7 @@ class Delta:
             "events": int(self.events),
             "created_unix": float(self.created_unix),
             "quality": dict(self.quality),
+            "event_ids": tuple(self.event_ids),
         })
 
     @classmethod
@@ -172,6 +188,7 @@ class Delta:
             events=int(d["events"]),
             created_unix=float(d["created_unix"]),
             quality=d.get("quality", {}),
+            event_ids=tuple(d.get("event_ids", ())),
         )
 
 
@@ -449,6 +466,20 @@ class DeltaPublisher:
     never seal: the epoch is not burned, a ``refusal-<epoch>.json``
     receipt lands next to the log, and ``on_receipt`` (when wired)
     records it in instance metadata.
+
+    Exactly-once on the fold side rests on two mechanisms:
+
+    * **One flush at a time** — ``_seal_lock`` serializes every flush
+      (the paced worker, size-triggered inline flushes on commit
+      threads, and the drain-time final fold) across epoch allocation,
+      the seal, and the publisher-side factor update, so two concurrent
+      flushes can never mint the same epoch or overwrite each other's
+      sealed blob.
+    * **Folded-event dedupe** — each sealed delta carries the durable
+      ids of the events it folded; a publisher primes its dedupe window
+      from the sealed log at construction and ``on_committed`` skips
+      events already folded (or already pending), so WAL replay and
+      committed-ring replay after a clean restart never double-fold.
     """
 
     def __init__(self, model, delta_log: DeltaLog, *,
@@ -474,20 +505,48 @@ class DeltaPublisher:
         self.gate_k = gate_k
         self.base_fingerprint = model_fingerprint(
             model.user_factors, model.item_factors)
-        self._lock = threading.Lock()
-        self._pending = []  # [(user_id, item_id, rating)]
+        self._lock = threading.Lock()  # buffers, counters, dedupe window
+        self._seal_lock = threading.Lock()  # serializes whole flushes
+        self._pending = []  # [(user_id, item_id, rating, event_id|None)]
+        self._pending_ids: set = set()  # durable ids buffered in _pending
+        self._folded_ids: set = set()  # recently folded durable ids
+        self._folded_order: collections.deque = collections.deque()
         self._sealed = 0
         self._seal_refused = 0
         self._events_folded = 0
         self._unknown_users = 0
+        self._dedup_skipped = 0
         self._last_receipt: Optional[dict] = None
+        # prime the dedupe window from the sealed log: after a clean
+        # restart, WAL/ring replay re-delivers events that already
+        # sealed into prior epochs — they must not fold twice
+        for epoch in delta_log.epochs():
+            try:
+                self._remember_folded(delta_log.read(epoch).event_ids)
+            except (ModelIntegrityError, OSError) as exc:
+                log.warning("dedupe prime skipped epoch %d: %s", epoch, exc)
+
+    def _remember_folded(self, event_ids) -> None:
+        """Record durable event ids as folded (bounded window).
+        Caller holds neither lock at __init__ time; every other caller
+        takes ``self._lock`` here."""
+        with self._lock:
+            for eid in event_ids:
+                if eid is None or eid in self._folded_ids:
+                    continue
+                self._folded_ids.add(eid)
+                self._folded_order.append(eid)
+            while len(self._folded_order) > _DEDUP_KEEP:
+                self._folded_ids.discard(self._folded_order.popleft())
 
     # -- ingestion hook ----------------------------------------------------
 
     def on_committed(self, events) -> None:
-        """Committed-event sink (exactly-once: fires on the storage-commit
-        path AND on WAL replay, so a delta lost to a pre-seal crash is
-        regrown from the same durable events)."""
+        """Committed-event sink (fires on the storage-commit path AND on
+        WAL/ring replay).  Replayed events whose durable id already
+        folded into a sealed epoch — or is already buffered — are
+        skipped, so a delta lost to a pre-seal crash is regrown from the
+        same durable events while a clean restart never folds twice."""
         batch = []
         for ev in events:
             ent = getattr(ev, "entity_id", None)
@@ -499,12 +558,21 @@ class DeltaPublisher:
                 rating = float(props.get("rating", 1.0))
             except (TypeError, ValueError):
                 rating = 1.0
-            batch.append((str(ent), str(tgt), rating))
+            eid = getattr(ev, "event_id", None)
+            batch.append((str(ent), str(tgt), rating, eid))
         if not batch:
             return
         flush_now = False
         with self._lock:
-            self._pending.extend(batch)
+            for item in batch:
+                eid = item[3]
+                if eid is not None and (eid in self._folded_ids
+                                        or eid in self._pending_ids):
+                    self._dedup_skipped += 1
+                    continue
+                if eid is not None:
+                    self._pending_ids.add(eid)
+                self._pending.append(item)
             flush_now = len(self._pending) >= self.max_events
         if flush_now:
             self.flush()
@@ -521,13 +589,25 @@ class DeltaPublisher:
         Returns the publish receipt (or None when there was nothing to
         fold).  A below-threshold fold-in is quarantined: nothing seals,
         the receipt says why, serving stays on the last-good epoch.
+
+        ``_seal_lock`` is held across the pending swap, epoch
+        allocation, gate, seal, and publisher-side factor update:
+        concurrent flushes (size-triggered on commit threads, the paced
+        worker, drain) serialize here, so epochs are allocated once and
+        a sealed blob is never silently overwritten by a racing seal.
         """
+        with self._seal_lock:
+            with self._lock:
+                pending, self._pending = self._pending, []
+                self._pending_ids = set()
+            if not pending:
+                return None
+            receipt = self._build_and_seal(pending)
+            # every flushed event is now accounted for (sealed, or
+            # dropped by a refusal receipt): never re-fold its replay
+            self._remember_folded(eid for _, _, _, eid in pending)
         with self._lock:
-            pending, self._pending = self._pending, []
-        if not pending:
-            return None
-        receipt = self._build_and_seal(pending)
-        self._last_receipt = receipt
+            self._last_receipt = receipt
         if self.on_receipt is not None:
             try:
                 self.on_receipt(receipt)
@@ -542,13 +622,18 @@ class DeltaPublisher:
         )
 
         by_user = {}
-        for user_id, item_id, rating in pending:
+        event_ids = []
+        for user_id, item_id, rating, eid in pending:
             by_user.setdefault(user_id, []).append((item_id, rating))
+            if eid is not None:
+                event_ids.append(eid)
         model = self.model
         interactions = {}
+        new_items = {}  # uidx -> item indices of THIS batch's events
+        prior_items = {}  # uidx -> items already counted by base/deltas
         user_ids = []
         unknown = 0
-        for user_id, pairs in by_user.items():
+        for user_id, batch_pairs in by_user.items():
             uidx = model.user_map.get(user_id)
             if uidx is None:
                 # fold-in updates existing rows in place; brand-new users
@@ -556,9 +641,10 @@ class DeltaPublisher:
                 # factor matrix never change mid-generation)
                 unknown += 1
                 continue
+            pairs = batch_pairs
             if self.history_fn is not None:
                 try:
-                    pairs = list(self.history_fn(user_id)) or pairs
+                    pairs = list(self.history_fn(user_id)) or batch_pairs
                 except Exception:
                     log.exception("history_fn failed for %r", user_id)
             items = []
@@ -569,12 +655,28 @@ class DeltaPublisher:
             if items:
                 interactions[uidx] = items
                 user_ids.append(user_id)
-        self._unknown_users += unknown
+                # cooc increments count only THIS batch's events — the
+                # history expansion above recomputes the fold-in row but
+                # its historical pairs were already counted by the base
+                # Gram and earlier deltas (multiset-subtracting the
+                # batch from the full history leaves the prior items,
+                # so cross pairs new×prior still count exactly once)
+                raw = collections.Counter(
+                    model.item_map.get(str(i)) for i, _ in batch_pairs)
+                raw.pop(None, None)
+                new_items[uidx] = list(raw)
+                if pairs is not batch_pairs:
+                    full = collections.Counter(
+                        model.item_map.get(str(i)) for i, _ in pairs)
+                    full.pop(None, None)
+                    prior_items[uidx] = list(full - raw)
         epoch = self.log.last_epoch() + 1
         if not interactions:
             receipt = {"refused": True, "reason": "empty", "epoch": epoch,
                        "events": len(pending), "unknown_users": unknown}
-            self._seal_refused += 1
+            with self._lock:
+                self._unknown_users += unknown
+                self._seal_refused += 1
             return receipt
 
         cfg = model.config
@@ -591,7 +693,9 @@ class DeltaPublisher:
         if overlap < self.min_overlap:
             # quarantine: nothing seals, epoch not burned, serving stays
             # on last-good; the refusal receipt is durable next to the log
-            self._seal_refused += 1
+            with self._lock:
+                self._unknown_users += unknown
+                self._seal_refused += 1
             receipt = {"refused": True, "reason": "quality", "epoch": epoch,
                        "events": len(pending), "users": len(user_idx),
                        "rolled_back_to": self.log.last_epoch(), **quality}
@@ -603,8 +707,7 @@ class DeltaPublisher:
                 self.log.last_epoch())
             return receipt
 
-        cooc = cooccurrence_increments(
-            {u: [i for i, _ in its] for u, its in interactions.items()})
+        cooc = cooccurrence_increments(new_items, prior_by_user=prior_items)
         delta = Delta(
             epoch=epoch, base_fingerprint=self.base_fingerprint,
             user_ids=tuple(user_ids), user_idx=user_idx,
@@ -612,13 +715,17 @@ class DeltaPublisher:
             item_idx=np.zeros((0,), np.int32),
             item_rows=np.zeros((0, cfg.rank), np.float32),
             cooc_updates=cooc, events=len(pending),
-            created_unix=time.time(), quality=quality)
+            created_unix=time.time(), quality=quality,
+            event_ids=tuple(event_ids))
         path = self.log.seal(delta)
         # keep the publisher's own base factors current so the NEXT
-        # fold-in gate references the updated rows too
+        # fold-in gate references the updated rows too (the caller's
+        # _seal_lock makes this write race-free against other flushes)
         model.user_factors[user_idx] = rows
-        self._sealed += 1
-        self._events_folded += len(pending)
+        with self._lock:
+            self._unknown_users += unknown
+            self._sealed += 1
+            self._events_folded += len(pending)
         return {"sealed": True, "epoch": epoch, "path": path,
                 "events": len(pending), "users": len(user_idx),
                 "unknown_users": unknown, **quality}
@@ -669,6 +776,7 @@ class DeltaPublisher:
                 "seal_refused": self._seal_refused,
                 "events_folded": self._events_folded,
                 "unknown_users": self._unknown_users,
+                "dedup_skipped": self._dedup_skipped,
                 "pending": len(self._pending),
                 "log_epoch": self.log.last_epoch(),
                 "base_fingerprint": self.base_fingerprint,

@@ -126,6 +126,11 @@ class EventServer:
         # WAL replay below: replay commits through _notify_committed,
         # which feeds this ring.
         self._delta_sinks: list = []
+        # guards ring-extend + sink-list snapshot against sink attach:
+        # attach snapshots the ring and appends the sink in ONE critical
+        # section, so every committed event lands in exactly one of
+        # {replay backlog, live dispatch} — no gap, no double delivery
+        self._sink_lock = threading.Lock()
         self._delta_publisher = None
         self._delta_flush_stop = threading.Event()
         self._delta_flush_thread: Optional[threading.Thread] = None
@@ -279,6 +284,11 @@ class EventServer:
                   "Events skipped because the user is not in the base "
                   "generation (waits for the next full retrain).",
                   [("", (), float(s["unknown_users"]))]),
+                F("pio_delta_dedup_skipped_total", "counter",
+                  "Replayed committed events skipped because their id "
+                  "already folded into a sealed epoch (exactly-once "
+                  "fold across WAL/ring replay).",
+                  [("", (), float(s["dedup_skipped"]))]),
                 F("pio_delta_pending_events", "gauge",
                   "Committed events buffered toward the next fold.",
                   [("", (), float(s["pending"]))]),
@@ -463,7 +473,11 @@ class EventServer:
                 for (i, event), eid in zip(group, ids):
                     self.stats_update(auth, event.event, 201)
                     results[i] = {"eventId": eid, "status": 201}
-                self._notify_committed(events)
+                # notify with the storage-assigned ids pinned: the delta
+                # publisher dedupes replays by durable event id
+                self._notify_committed([
+                    e.with_id(eid) for (_, e), eid in zip(group, ids)
+                ])
                 continue
             for i, event in group:
                 try:
@@ -474,7 +488,7 @@ class EventServer:
                 else:
                     self.stats_update(auth, event.event, 201)
                     results[i] = {"eventId": eid, "status": 201}
-                    self._notify_committed([event])
+                    self._notify_committed([event.with_id(eid)])
         return results
 
     def _insert_event(self, auth: dict, event: Event) -> Response:
@@ -487,7 +501,7 @@ class EventServer:
         le = self.storage.get_l_events()
         le.init(auth["app_id"], auth["channel_id"])
         event_id = le.insert(event, auth["app_id"], auth["channel_id"])
-        self._notify_committed([event])
+        self._notify_committed([event.with_id(event_id)])
         self.stats_update(auth, event.event, 201)
         return json_response(201, {"eventId": event_id})
 
@@ -501,9 +515,11 @@ class EventServer:
         except Exception:
             logger.exception("cache-invalidation hook failed; TTL backstop "
                              "bounds staleness")
-        if self._recent_committed is not None:
-            self._recent_committed.extend(events)
-        for sink in self._delta_sinks:
+        with self._sink_lock:
+            if self._recent_committed is not None:
+                self._recent_committed.extend(events)
+            sinks = tuple(self._delta_sinks)
+        for sink in sinks:
             # same contract as the cache hook: a sink failure never fails
             # a write that already landed (the delta pipeline regrows
             # from the WAL / event store instead)
@@ -519,15 +535,22 @@ class EventServer:
         bounded ring of events committed before attachment (WAL replay in
         ``__init__``, early writes) into the new sink first, so a
         publisher attached after construction still sees every acked
-        event."""
-        if replay_recent and self._recent_committed:
-            backlog = list(self._recent_committed)
+        event.  The ring snapshot and the sink append happen in one
+        ``_sink_lock`` critical section against ``_notify_committed``:
+        an event committed concurrently with attachment is either in the
+        snapshot (ring extended first) or dispatched live (sink appended
+        first) — never neither, never both."""
+        backlog: list = []
+        with self._sink_lock:
+            if replay_recent and self._recent_committed:
+                backlog = list(self._recent_committed)
+            self._delta_sinks.append(sink)
+        if backlog:
             try:
                 sink(backlog)
             except Exception:
                 logger.exception("delta sink failed replaying %d committed "
                                  "events", len(backlog))
-        self._delta_sinks.append(sink)
 
     def enable_delta_publisher(self, model, delta_dir: Optional[str] = None,
                                on_receipt=None, **publisher_kw):

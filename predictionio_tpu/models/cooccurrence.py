@@ -575,24 +575,36 @@ def _train_cooccurrence_sharded(
 # ---------------------------------------------------------------------------
 
 
-def cooccurrence_increments(items_by_user: dict) -> np.ndarray:
+def cooccurrence_increments(items_by_user: dict,
+                            prior_by_user: Optional[dict] = None
+                            ) -> np.ndarray:
     """Pair-count increments from freshly committed interactions.
 
     ``items_by_user`` maps a user index to the item indices of that
-    user's new events.  Every unordered within-user pair contributes one
-    ``(item_a, item_b, +count)`` row (``item_a < item_b``), the exact
-    increment the full-retrain co-occurrence Gram accumulates for those
-    events — so a delta carries the same counting signal the next full
-    rebuild will see, and the streaming accumulator converges to it.
+    user's NEW events only.  ``prior_by_user`` (optional) maps the same
+    user to the items the base generation and earlier deltas already
+    counted for them.  The increment for each user is
+    ``pairs(prior ∪ new) − pairs(prior)``: every unordered pair among
+    the genuinely new items, plus every cross pair new×prior, each as a
+    ``(item_a, item_b, +count)`` row (``item_a < item_b``).  That is the
+    exact delta the full-retrain co-occurrence Gram would gain from
+    those events — historical pairs are never re-counted, so a replica
+    accumulator fed these increments converges to the next full rebuild
+    instead of inflating past it.
 
     Returns an (m, 3) int64 array, deduplicated and sorted.
     """
     counts: dict = {}
-    for items in items_by_user.values():
-        uniq = sorted(set(int(i) for i in items))
-        for i, a in enumerate(uniq):
-            for b in uniq[i + 1:]:
+    prior_by_user = prior_by_user or {}
+    for user, items in items_by_user.items():
+        prior = set(int(i) for i in prior_by_user.get(user, ()))
+        new = sorted(set(int(i) for i in items) - prior)
+        for i, a in enumerate(new):
+            for b in new[i + 1:]:
                 counts[(a, b)] = counts.get((a, b), 0) + 1
+            for p in prior:
+                key = (a, p) if a < p else (p, a)
+                counts[key] = counts.get(key, 0) + 1
     if not counts:
         return np.zeros((0, 3), np.int64)
     return np.array(

@@ -55,10 +55,12 @@ def tiny_model(rank=4, n_users=12, n_items=10, seed=7):
 class Ev:
     """Committed-event shape the publisher sink consumes."""
 
-    def __init__(self, entity_id, target_entity_id, rating=1.0):
+    def __init__(self, entity_id, target_entity_id, rating=1.0,
+                 event_id=None):
         self.entity_id = entity_id
         self.target_entity_id = target_entity_id
         self.properties = {"rating": rating}
+        self.event_id = event_id
 
 
 def publish(model, log_dir, events, **kw):
@@ -191,6 +193,107 @@ class TestDeltaLogApplier:
         log.prune(keep=2)
         assert log.epochs() == [4, 5]
         assert log.last_epoch() == 5
+
+
+# -- exactly-once fold: seal serialization + replay dedupe -------------------
+
+
+class TestExactlyOnceFold:
+    def test_concurrent_flushes_allocate_distinct_epochs(self, tmp_path):
+        """Racing flushes (size-triggered on commit threads, the paced
+        worker, drain) must serialize on epoch allocation: every sealed
+        blob gets its own epoch and every acked event lands in exactly
+        one sealed delta — no silent overwrite of a just-sealed file."""
+        import threading
+
+        m = tiny_model()
+        log = delta_mod.DeltaLog(str(tmp_path))
+        pub = delta_mod.DeltaPublisher(m, log, min_overlap=0.0)
+        per_thread, threads = 5, 8
+        ids = [f"e{t}-{j}" for t in range(threads)
+               for j in range(per_thread)]
+        start = threading.Barrier(threads)
+
+        def worker(t):
+            start.wait()
+            for j in range(per_thread):
+                pub.on_committed([Ev(f"u{(t + j) % 12}", f"i{j % 10}", 3.0,
+                                     event_id=f"e{t}-{j}")])
+                pub.flush()
+
+        ts = [threading.Thread(target=worker, args=(t,))
+              for t in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        pub.flush()  # the drain-time final fold
+        epochs = log.epochs()
+        assert epochs == list(range(1, len(epochs) + 1))  # no holes
+        folded = []
+        for e in epochs:
+            folded.extend(log.read(e).event_ids)
+        # exactly-once: every acked event folded into exactly one epoch
+        assert sorted(folded) == sorted(ids)
+        assert pub.stats()["sealed"] == len(epochs)
+
+    def test_restarted_publisher_skips_already_folded_events(
+        self, tmp_path
+    ):
+        """Clean restart: WAL/ring replay re-delivers events that already
+        sealed into a prior epoch; the publisher primes its dedupe window
+        from the sealed log and never folds them twice."""
+        base = tiny_model()
+        events = [Ev("u1", "i2", 5.0, event_id="e-1"),
+                  Ev("u3", "i4", 2.0, event_id="e-2")]
+        r1, _ = publish(copy.deepcopy(base), str(tmp_path), events,
+                        min_overlap=0.0)
+        assert r1["sealed"] and r1["epoch"] == 1
+        # "restart": a fresh publisher over the same sealed log
+        log = delta_mod.DeltaLog(str(tmp_path))
+        pub2 = delta_mod.DeltaPublisher(copy.deepcopy(base), log,
+                                        min_overlap=0.0)
+        pub2.on_committed(events)  # the replayed delivery
+        assert pub2.pending() == 0
+        assert pub2.stats()["dedup_skipped"] == 2
+        assert pub2.flush() is None
+        assert log.epochs() == [1]
+        # a genuinely new event still folds, alone, into the next epoch
+        pub2.on_committed(events + [Ev("u5", "i6", 4.0, event_id="e-3")])
+        assert pub2.flush()["sealed"]
+        assert log.read(2).event_ids == ("e-3",)
+
+    def test_history_fn_cooc_counts_only_new_events(self, tmp_path):
+        """With ``history_fn`` the fold-in row is recomputed from the
+        user's FULL history, but the cooc increment must cover only this
+        batch's events: historical pairs were already counted by the
+        base Gram and earlier deltas (no inflation), while cross pairs
+        new×prior still count exactly once (no undercount)."""
+        m = tiny_model()
+        histories = {"u1": [("i1", 5.0), ("i2", 4.0)]}
+
+        def history_fn(user_id):
+            return list(histories.get(user_id, []))
+
+        log = delta_mod.DeltaLog(str(tmp_path))
+        pub = delta_mod.DeltaPublisher(m, log, history_fn=history_fn,
+                                       min_overlap=0.0)
+        i1, i2, i3 = (m.item_map[k] for k in ("i1", "i2", "i3"))
+        # first delta: both events are new — one within-batch pair
+        pub.on_committed([Ev("u1", "i1", 5.0), Ev("u1", "i2", 4.0)])
+        assert pub.flush()["sealed"]
+        np.testing.assert_array_equal(
+            log.read(1).cooc_updates, [[min(i1, i2), max(i1, i2), 1]])
+        # second delta: one new event against two historical items —
+        # exactly the two cross pairs, and (i1, i2) is NOT re-counted
+        histories["u1"].append(("i3", 3.0))
+        pub.on_committed([Ev("u1", "i3", 3.0)])
+        assert pub.flush()["sealed"]
+        got = {(int(a), int(b)): int(c)
+               for a, b, c in log.read(2).cooc_updates}
+        want = {(min(i1, i3), max(i1, i3)): 1,
+                (min(i2, i3), max(i2, i3)): 1}
+        assert got == want
 
 
 # -- exact-equality property -------------------------------------------------
@@ -618,6 +721,39 @@ class TestEventServerPublisher:
             assert st["sealed"] == 1 and st["log_epoch"] == 1
             dl = delta_mod.DeltaLog(str(tmp_path / "log")).read(1)
             assert set(dl.user_ids) == {"u1", "u3"}
+        finally:
+            es.stop()
+
+    def test_replayed_commits_never_double_fold(
+        self, storage, tmp_path, monkeypatch
+    ):
+        """Clean-restart shape at the server level: events reach the
+        publisher through the ring replay on attach, are sealed, and a
+        later re-delivery of the same committed events (WAL replay) is
+        skipped by the folded-id window instead of growing a bogus
+        second epoch."""
+        monkeypatch.setenv("PIO_STREAMING", "1")
+        monkeypatch.setenv("PIO_DELTA_FLUSH_MS", "60000")
+        from predictionio_tpu.data.api.event_server import EventServer
+
+        es = EventServer(storage=storage, telemetry=False)
+        try:
+            events = [Ev("u1", "i2", 5.0, event_id="wal-1"),
+                      Ev("u3", "i4", 2.0, event_id="wal-2")]
+            es._notify_committed(events)
+            pub = es.enable_delta_publisher(
+                tiny_model(), delta_dir=str(tmp_path / "log"),
+                min_overlap=0.0,
+            )
+            es._delta_flush_once()
+            assert pub.stats()["sealed"] == 1
+            # the WAL-replay shape: the same durable events again
+            es._notify_committed(events)
+            assert pub.pending() == 0
+            assert pub.stats()["dedup_skipped"] == 2
+            es._delta_flush_once()
+            st = pub.stats()
+            assert st["sealed"] == 1 and st["log_epoch"] == 1
         finally:
             es.stop()
 
