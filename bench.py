@@ -2150,6 +2150,154 @@ def _sharded_serving_bench(ctx) -> dict:
     }
 
 
+_POD_BENCH_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json
+import numpy as np
+from predictionio_tpu.parallel import distributed
+
+assert distributed.initialize()
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.serving import sharding as _sharding
+from predictionio_tpu.serving.fastpath import BucketedScorer
+
+ctx = MeshContext.create()
+rng = np.random.default_rng({seed})
+U = rng.standard_normal(({n_users}, {rank})).astype(np.float32)
+V = rng.standard_normal(({n_items}, {rank})).astype(np.float32)
+batches = [rng.integers(0, {n_users}, n).astype(np.int32) for n in (1, 13)]
+plan = _sharding.build_plan({n_items}, 4, host_groups=2)
+sc = BucketedScorer(ctx, U, V, max_k={k}, buckets=(1, 8),
+                    sharding="sharded", plan=plan)
+cells = []
+for users in batches:
+    idx, vals = sc.score_topk(users, {k})
+    cells.append({{"idx": np.asarray(idx).tolist(),
+                  "vals": np.asarray(vals, np.float64).tolist()}})
+st = sc.stats()
+pod = st["pod"]
+shard = (st.get("sharding") or {{}})
+print("POD_RESULT " + json.dumps({{
+    "cells": cells,
+    "pod_bytes": pod["cross_host_merge_bytes"],
+    "pod_seconds": pod["cross_host_merge_seconds"],
+    "dispatches": pod["dispatches"],
+    "on_host_merge_bytes": shard.get("merge_bytes"),
+    "host_groups": pod["host_groups"],
+    "process_count": pod["process_count"],
+}}))
+"""
+
+
+def _pod_serving_bench() -> dict:
+    """Pod-scale serving gate (ISSUE 18): a REAL 2-process
+    ``jax.distributed`` CPU mesh (Gloo collectives, 2 virtual devices per
+    process) serves a 4-shard / 2-host-group plan through the two-tier
+    merge, and the parent replays the same workload on a single-process
+    replicated scorer.
+
+    Gates: (a) the pod answers are BIT-identical to the replicated
+    reference — indices and values, every dispatch; (b) the measured
+    cross-host merge traffic is <= the ``H*B*k*8`` two-tier derivation in
+    docs/perf_roofline.md (it lands exactly on it; the bound keeps the
+    gate honest if accounting grows).  The flat single-tier collective
+    would have moved ``S*B*local_k*8`` across hosts — the reduction
+    factor is reported alongside.
+    """
+    import socket
+    import subprocess
+    import tempfile
+
+    from predictionio_tpu.parallel.mesh import MeshContext
+    from predictionio_tpu.serving.fastpath import BucketedScorer
+
+    n_users, n_items, rank, k, seed = 40, 320, 8, 10, 11
+    script = _POD_BENCH_WORKER.format(
+        repo=os.path.dirname(os.path.abspath(__file__)),
+        seed=seed, n_users=n_users, n_items=n_items, rank=rank, k=k,
+    )
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord_port = s.getsockname()[1]
+    with tempfile.TemporaryDirectory(prefix="pio-pod-bench-") as tmp:
+        path = os.path.join(tmp, "pod_worker.py")
+        with open(path, "w") as f:
+            f.write(script)
+        procs = []
+        for pid in (0, 1):
+            env = dict(os.environ)
+            env.update(
+                PIO_COORDINATOR=f"127.0.0.1:{coord_port}",
+                PIO_NUM_PROCESSES="2",
+                PIO_PROCESS_ID=str(pid),
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, path], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+                if p.returncode != 0:
+                    raise RuntimeError(f"pod bench worker failed:\n{out}")
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+    got = json.loads(next(
+        ln for ln in outs[0].splitlines() if ln.startswith("POD_RESULT ")
+    )[len("POD_RESULT "):])
+
+    # replicated reference on the parent's own mesh, same seeded workload
+    rng = np.random.default_rng(seed)
+    U = rng.standard_normal((n_users, rank)).astype(np.float32)
+    V = rng.standard_normal((n_items, rank)).astype(np.float32)
+    batches = [rng.integers(0, n_users, n).astype(np.int32) for n in (1, 13)]
+    repl = BucketedScorer(
+        MeshContext.create(), U, V, max_k=k, buckets=(1, 8),
+        sharding="replicated",
+    )
+    exact = True
+    for cell, users in zip(got["cells"], batches):
+        ref_idx, ref_val = repl.score_topk(users, k)
+        exact = exact and bool(
+            np.array_equal(np.asarray(cell["idx"], np.int32), ref_idx)
+            and np.array_equal(
+                np.asarray(cell["vals"], np.float64),
+                np.asarray(ref_val, np.float64),
+            )
+        )
+    # two-tier derivation (docs/perf_roofline.md): H*b*k*8 per dispatch
+    # over the padded rungs b = 1, 8, 8; flat would be S*b*local_k*8
+    h, s_shards, local_k = 2, 4, k
+    rungs = (1, 8, 8)
+    derived = float(sum(h * b * k * 8 for b in rungs))
+    flat = float(sum(s_shards * b * local_k * 8 for b in rungs))
+    measured = float(got["pod_bytes"])
+    return {
+        "processes": int(got["process_count"]),
+        "host_groups": int(got["host_groups"]),
+        "n_shards": s_shards,
+        "k": k,
+        "dispatches": int(got["dispatches"]),
+        "exact_match": exact,
+        "cross_host_merge_bytes": measured,
+        "cross_host_merge_bytes_derived": derived,
+        "flat_merge_bytes": flat,
+        "reduction_factor": round(flat / measured, 4) if measured else None,
+        "cross_host_merge_seconds": got["pod_seconds"],
+        "on_host_merge_bytes": got["on_host_merge_bytes"],
+        "gate_pass": bool(exact and measured and measured <= derived),
+    }
+
+
 def _retrieval_bench(ctx, platform) -> dict:
     """IVF retrieval gate (ISSUE 16): serve a clustered catalog through the
     coarse-partition fast path at the DEFAULT nprobe and prove the two
@@ -2481,6 +2629,14 @@ def main() -> None:
                   file=sys.stderr)
             sharded = {"error": str(e)}
         print(f"INFO: sharded_serving: {sharded}", file=sys.stderr)
+    pod = None
+    if os.environ.get("BENCH_POD", "1") != "0":
+        try:
+            pod = _pod_serving_bench()
+        except Exception as e:  # the pod bench must never kill the artifact
+            print(f"WARNING: pod serving bench failed: {e}", file=sys.stderr)
+            pod = {"error": str(e)}
+        print(f"INFO: pod_serving: {pod}", file=sys.stderr)
     retrieval = None
     if os.environ.get("BENCH_RETRIEVAL", "1") != "0":
         try:
@@ -2535,8 +2691,12 @@ def main() -> None:
         record["elastic"] = elastic
     if freshness is not None:
         record["freshness"] = freshness
-    if sharded is not None:
-        record["multichip"] = {"sharded_serving": sharded}
+    if sharded is not None or pod is not None:
+        record["multichip"] = {}
+        if sharded is not None:
+            record["multichip"]["sharded_serving"] = sharded
+        if pod is not None:
+            record["multichip"]["pod_serving"] = pod
     if retrieval is not None:
         record["retrieval"] = retrieval
     if "zipf" in results and primary_dist != "zipf":

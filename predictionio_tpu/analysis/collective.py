@@ -80,6 +80,15 @@ R_HOST_IN_CALLEE = rule(
     "parameter forces a host round trip or fails exactly like it would "
     "in the jitted body itself",
 )
+R_TWO_TIER_AXES = rule(
+    "collective-two-tier-axes",
+    "error",
+    "two_tier_merge_topk called with group_axis == host_axis",
+    "the two merge tiers collapse onto one mesh axis: the cross-host "
+    "gather's arity becomes the whole axis and the on-host tier gathers "
+    "the same shards again — the flat collective the two-tier merge "
+    "exists to avoid, at double the traffic",
+)
 
 _COLLECTIVES = {
     "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
@@ -271,9 +280,18 @@ def _mesh_axes(
     if isinstance(expr, ast.Name) and expr.id in local_assigns:
         return _mesh_axes(local_assigns[expr.id], consts, local_str,
                           local_assigns, depth + 1)
+    if isinstance(expr, ast.Attribute) and expr.attr == "mesh":
+        # ctx.pod_submesh(...).mesh / sc.mesh where sc resolves to a
+        # pod_submesh call — unwrap to the builder expression
+        return _mesh_axes(expr.value, consts, local_str, local_assigns,
+                          depth + 1)
     if not isinstance(expr, ast.Call):
         return None
     fname = _call_name(expr)
+    if fname == "pod_submesh":
+        # MeshContext.pod_submesh always builds a (HOST_AXIS, DATA_AXIS)
+        # mesh (parallel/mesh.py) — the axis set is fixed by construction
+        return {"host", "data"}
     if fname == "make_mesh":
         for kw in expr.keywords:
             if kw.arg == "axes" and isinstance(kw.value, ast.Dict):
@@ -346,6 +364,12 @@ def _used_axes(
             for kw in n.keywords:
                 if kw.arg == "axis_name":
                     add_axis_expr(kw.value, n.lineno, "partial")
+        elif cname == "two_tier_merge_topk":
+            # the pod leaderboard merge is a compound collective: its two
+            # axis kwargs must be in scope exactly like a raw all_gather's
+            for kw in n.keywords:
+                if kw.arg in ("group_axis", "host_axis"):
+                    add_axis_expr(kw.value, n.lineno, cname)
     return out
 
 
@@ -402,6 +426,36 @@ def _check_shard_maps(
                     f"specs only bind {sorted(scope)}",
                     symbol=ax,
                 ))
+    return out
+
+
+def _check_two_tier(mod: Module, consts: _Consts) -> list[Finding]:
+    """Degenerate two-tier merges: ``group_axis == host_axis`` makes the
+    tier-2 gather's arity the whole axis (the flat collective again,
+    gathered twice).  Checked wherever BOTH kwargs statically resolve —
+    parameterised axis names are skipped, never guessed."""
+    out: list[Finding] = []
+    if mod.tree is None:
+        return out
+    parents = mod.parents()
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node) == "two_tier_merge_topk"):
+            continue
+        encl = _enclosing_fn(node, parents) or mod.tree
+        local_str = _local_str_assigns(encl)
+        axes: dict[str, Optional[str]] = {}
+        for kw in node.keywords:
+            if kw.arg in ("group_axis", "host_axis"):
+                axes[kw.arg] = consts.resolve(kw.value, local_str)
+        g, h = axes.get("group_axis"), axes.get("host_axis")
+        if g is not None and g == h:
+            out.append(finding(
+                R_TWO_TIER_AXES, mod, node.lineno,
+                f"two_tier_merge_topk merges both tiers over axis {g!r}; "
+                "group_axis and host_axis must be distinct mesh axes",
+                symbol=g,
+            ))
     return out
 
 
@@ -719,7 +773,7 @@ def _scan_callee(
 from predictionio_tpu.analysis.core import owns_rules
 
 owns_rules("collective", R_MESH_AXIS.id, R_UNKNOWN_AXIS.id,
-           R_INDEX_MAP_ARITY.id, R_HOST_IN_CALLEE.id)
+           R_INDEX_MAP_ARITY.id, R_HOST_IN_CALLEE.id, R_TWO_TIER_AXES.id)
 
 
 @analyzer("collective")
@@ -731,6 +785,7 @@ def analyze_collective(index: RepoIndex) -> list[Finding]:
             continue
         consts = _Consts(index, mod)
         out.extend(_check_shard_maps(index, mod, consts))
+        out.extend(_check_two_tier(mod, consts))
         out.extend(_check_pallas(mod))
     out.extend(_callee_taint_check(index, graph))
     return out

@@ -29,6 +29,13 @@ SEEDED schedule, at named fault SITES compiled into the service planes:
   push on the router→replica ``POST /delta`` hop (latency / simulated
   drop / simulated 5xx): a replica that misses the push must catch up
   from the sealed delta log before readmission, never diverge.
+* ``client:pod:merge`` — consulted by the router before a forward into
+  a pod HOST GROUP (the replica advertised a ``pod.group`` on /readyz):
+  models the cross-host leaderboard merge tearing when a member process
+  of the group dies mid-collective (latency / drop / 5xx).  The chaos
+  suite fires it — and SIGKILLs group members — to prove the router's
+  group-preferred pick degrades to fleet-wide with zero client-visible
+  failures until the group heals.
 * ``crash:delta:before_seal`` — compiled into ``DeltaLog.seal``: the
   publisher dies after the ingest WAL ack but before the delta blob is
   sealed; replay of the durable events must regrow the identical delta.

@@ -1442,13 +1442,16 @@ class CheckpointedALSModel(ALSModel):
             return {"n_shards": 0}
         _sharding.save_plan(os.path.join(d, "plan.blob"), plan)
         logger.info(
-            "sharding plan sealed: %d shards (%s), fingerprint %s",
-            plan.n_shards, plan.strategy, plan.fingerprint,
+            "sharding plan sealed: %d shards / %d host groups (%s), "
+            "fingerprint %s",
+            plan.n_shards, plan.host_groups, plan.strategy,
+            plan.fingerprint,
         )
         return {
             "n_shards": plan.n_shards,
             "strategy": plan.strategy,
             "fingerprint": plan.fingerprint,
+            "host_groups": plan.host_groups,
         }
 
     def _publish_quantized(self, d: str) -> dict:

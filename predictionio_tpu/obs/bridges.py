@@ -405,6 +405,89 @@ def bridge_sharding(
     registry.register_collector(collect)
 
 
+def bridge_pod(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """Pod-scale serving accounting → pio_pod_* series.
+
+    One bridge, two emitters: a query server's fastpath exposes its
+    ``pod`` stats block (host-group topology, process slot, cross-host
+    merge traffic), a router exposes its shard-aware fan-out counters
+    (per-group queries routed, fleet-wide fallback broadcasts).  Each
+    family appears exactly when its source key is present — no pod plan,
+    no series (the ``pio_shard_*`` presence contract).
+    """
+
+    def collect():
+        pod = stats_fn()
+        if not isinstance(pod, dict):
+            return []
+        fams = []
+        hg = pod.get("host_groups")
+        if hg:
+            fams.append(_fam(
+                "pio_pod_host_groups", "gauge",
+                "Host groups in the active pod serving mesh.",
+                [("", (), _num(hg))],
+            ))
+        routed = pod.get("queries_routed")
+        if isinstance(routed, dict):
+            fams.append(_fam(
+                "pio_pod_queries_routed_total", "counter",
+                "Queries the router fanned to their owning host group "
+                "(shard-aware routing; one group per query).",
+                [("", (("group", str(g)),), _num(n))
+                 for g, n in sorted(routed.items(), key=lambda kv:
+                                    str(kv[0]))],
+            ))
+        if "fallback_broadcasts" in pod:
+            fams.append(_fam(
+                "pio_pod_fallback_broadcasts_total", "counter",
+                "Queries routed fleet-wide because the owning group had "
+                "no eligible replica or the plan map was missing — the "
+                "documented degrade path.",
+                [("", (), _num(pod.get("fallback_broadcasts")))],
+            ))
+        if "cross_host_merge_bytes" in pod:
+            fams.append(_fam(
+                "pio_pod_cross_host_merge_bytes_total", "counter",
+                "Cumulative cross-host leaderboard payload: the (H, B, "
+                "k) tier-2 gather only (tier-1 stays on-host; "
+                "perf_roofline.md derives the S/H reduction).",
+                [("", (), _num(pod.get("cross_host_merge_bytes")))],
+            ))
+        if "cross_host_merge_seconds" in pod:
+            fams.append(_fam(
+                "pio_pod_cross_host_merge_seconds_total", "counter",
+                "Device wall attributed to the cross-host merge tier "
+                "(its byte share of each dispatch).",
+                [("", (), _num(pod.get("cross_host_merge_seconds")))],
+            ))
+        if "dispatches" in pod:
+            fams.append(_fam(
+                "pio_pod_merge_dispatches_total", "counter",
+                "Device dispatches that ran the two-tier pod merge.",
+                [("", (), _num(pod.get("dispatches")))],
+            ))
+        if "process_count" in pod:
+            fams.append(_fam(
+                "pio_pod_process_info", "gauge",
+                "This process's slot in the pod launch (info gauge; "
+                "labels carry index/count).",
+                [(
+                    "",
+                    (
+                        ("index", str(int(_num(pod.get("process_index"))))),
+                        ("count", str(int(_num(pod.get("process_count"))))),
+                    ),
+                    1.0,
+                )],
+            ))
+        return fams
+
+    registry.register_collector(collect)
+
+
 def bridge_ivf(
     registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
 ) -> None:

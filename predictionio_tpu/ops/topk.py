@@ -91,6 +91,51 @@ def merge_topk(
     return -neg_vals[:, :k], idx[:, :k]
 
 
+def two_tier_merge_topk(
+    values: jax.Array,
+    indices: jax.Array,
+    k: int,
+    *,
+    group_axis: str,
+    host_axis: str,
+) -> tuple[jax.Array, jax.Array]:
+    """Pod-mesh leaderboard merge: on-host gather+merge, then one small
+    cross-host gather+merge.  Called INSIDE ``shard_map`` over a 2-D
+    ``(host_axis, group_axis)`` mesh.
+
+    ``values``/``indices`` are this shard's local ``(B, local_k)``
+    leaderboard (global item ids).  Tier 1 all-gathers the G on-host
+    shards over ``group_axis`` — a device collective inside the host row,
+    ICI on a real pod — and merges them to one per-host ``(B, k)``
+    leaderboard.  Tier 2 all-gathers the H host leaderboards over
+    ``host_axis`` and merges again; that ``H·B·k·8``-byte gather is the
+    ONLY cross-host traffic, ``S/H × local_k/k`` smaller than the flat
+    ``(S, B, local_k)`` all-gather it replaces (byte derivation in
+    docs/perf_roofline.md).  Both tiers rerank with :func:`merge_topk`'s
+    two-key ``(value desc, id asc)`` sort — exactly ``lax.top_k``'s tie
+    order — so tiering the merge cannot change a single winner: the
+    result is bit-identical to one ``top_k`` over the full score row.
+    Returns replicated ``(values (B, k), indices (B, k))``.
+    """
+    b = values.shape[0]
+    gv = jax.lax.all_gather(values, group_axis)  # (G, B, local_k)
+    gg = jax.lax.all_gather(indices, group_axis)
+    g, lk = gv.shape[0], gv.shape[2]
+    host_v, host_g = merge_topk(
+        jnp.swapaxes(gv, 0, 1).reshape(b, g * lk),
+        jnp.swapaxes(gg, 0, 1).reshape(b, g * lk),
+        min(k, g * lk),
+    )
+    cv = jax.lax.all_gather(host_v, host_axis)  # (H, B, k) — the DCN hop
+    cg = jax.lax.all_gather(host_g, host_axis)
+    h, hk = cv.shape[0], cv.shape[2]
+    return merge_topk(
+        jnp.swapaxes(cv, 0, 1).reshape(b, h * hk),
+        jnp.swapaxes(cg, 0, 1).reshape(b, h * hk),
+        k,
+    )
+
+
 def _dequantize(F: jax.Array, scale: Optional[jax.Array]) -> jax.Array:
     """XLA-side dequantize: the f32 math the fused kernel does in VMEM."""
     if F.dtype != jnp.float32:

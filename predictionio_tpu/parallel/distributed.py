@@ -52,6 +52,7 @@ def initialize(
         process_id = int(os.environ.get("PIO_PROCESS_ID", "0"))
     import jax
 
+    _enable_cpu_collectives(jax)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -75,6 +76,25 @@ def initialize(
         len(jax.devices()),
     )
     return True
+
+
+def _enable_cpu_collectives(jax_mod) -> None:
+    """Select the Gloo collectives implementation for multi-process CPU.
+
+    The CPU PJRT client defaults to NO cross-process collectives — the
+    first psum/all_gather that crosses a process dies with "Multiprocess
+    computations aren't implemented on the CPU backend".  Flipping the
+    config to ``gloo`` (TCP) before the backend is created fixes every
+    CPU pod run (the 2-process test/bench meshes included).  Applied only
+    when JAX_PLATFORMS pins cpu: probing the platform any other way would
+    instantiate the backend before ``jax.distributed.initialize``.
+    """
+    if (os.environ.get("JAX_PLATFORMS") or "").strip().lower() != "cpu":
+        return
+    try:
+        jax_mod.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - very old/new jaxlib
+        logger.warning("could not enable gloo CPU collectives", exc_info=True)
 
 
 def is_initialized() -> bool:

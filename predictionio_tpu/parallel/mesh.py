@@ -33,6 +33,11 @@ logger = logging.getLogger(__name__)
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+# pod-scale serving: the cross-host dimension of a 2-D (host, data) mesh.
+# jax.devices() enumerates process-major, so host-axis rows coincide with
+# process boundaries and a collective over HOST_AXIS is genuinely DCN
+# traffic on a multi-process pod (see MeshContext.pod_submesh).
+HOST_AXIS = "host"
 
 # -- jax version compatibility ----------------------------------------------
 # shard_map graduated from jax.experimental to the jax namespace (and grew
@@ -186,6 +191,62 @@ class MeshContext:
         return MeshContext(
             mesh=make_mesh(axes={axis: n_devices}, devices=devs),
             conf=dict(self.conf),
+        )
+
+    def pod_submesh(self, n_shards: int, host_groups: int) -> "MeshContext":
+        """A 2-D ``(host, data)`` context over the first ``n_shards`` devices.
+
+        The pod-scale serving layout: ``host_groups`` rows of
+        ``n_shards // host_groups`` devices each.  Because the prefix
+        carve keeps ``jax.devices()``'s process-major order, each host row
+        is ICI-local whenever ``n_shards / host_groups`` divides the
+        per-process device count — the on-host tier of the two-tier
+        leaderboard merge then never touches DCN, and only the tiny
+        ``(H, B, k)`` host-axis gather crosses processes.
+        """
+        if host_groups < 1 or n_shards % host_groups:
+            raise ValueError(
+                f"host_groups={host_groups} must divide n_shards={n_shards}"
+            )
+        if n_shards > self.mesh.size:
+            raise ValueError(
+                f"pod submesh of {n_shards} devices from a "
+                f"{self.mesh.size}-device mesh"
+            )
+        devs = list(self.mesh.devices.flat)[:n_shards]
+        return MeshContext(
+            mesh=make_mesh(
+                axes={HOST_AXIS: host_groups,
+                      DATA_AXIS: n_shards // host_groups},
+                devices=devs,
+            ),
+            conf=dict(self.conf),
+        )
+
+    @property
+    def spans_processes(self) -> bool:
+        """True when some mesh device belongs to another process — plain
+        ``device_put``/``device_get`` then can't touch the whole array and
+        placement must go through :meth:`place` / ``addressable_data``."""
+        me = jax.process_index()
+        return any(d.process_index != me for d in self.mesh.devices.flat)
+
+    def place(self, x, *spec: Any):
+        """Place a host array under ``spec``, multi-process safe.
+
+        Single-process meshes take the ordinary ``device_put``.  When the
+        mesh spans processes, every process holds the SAME full host copy
+        (the SPMD serving contract) and ``make_array_from_callback`` hands
+        each process exactly its addressable shards of the global array.
+        """
+        arr = np.asarray(x)
+        sharding = self.sharding(*spec)
+        if not self.spans_processes:
+            import jax.numpy as jnp
+
+            return jax.device_put(jnp.asarray(arr), sharding)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
         )
 
     def shard_rows(self, x, axis: str = DATA_AXIS):

@@ -82,10 +82,10 @@ from predictionio_tpu.ops import ivf as _ivf
 from predictionio_tpu.ops import quantize as _quantize
 from predictionio_tpu.ops import score_kernel as _score_kernel
 from predictionio_tpu.ops.topk import (
-    gather_score_topk, merge_topk, resolve_backend,
+    gather_score_topk, merge_topk, resolve_backend, two_tier_merge_topk,
 )
 from predictionio_tpu.parallel.mesh import (
-    DATA_AXIS, MeshContext, pad_to_multiple, shard_map,
+    DATA_AXIS, HOST_AXIS, MeshContext, pad_to_multiple, shard_map,
 )
 from predictionio_tpu.serving import sharding as _sharding
 from predictionio_tpu.utils import profiling as _profiling
@@ -210,12 +210,20 @@ class BucketedScorer:
         if factor_dtype == "f32":
             user_factors = np.asarray(user_factors, np.float32)
             item_factors = np.asarray(item_factors, np.float32)
+        # pod layout: plans with >1 host group run the two-tier merge over
+        # a 2-D (host, data) mesh; placement/readback must then go through
+        # the multi-process-safe helpers below
+        self._pod = bool(
+            self.sharding == "sharded"
+            and getattr(plan, "host_groups", 1) > 1
+        )
+        self._pod_spans = False
         if self.sharding == "sharded":
             self._init_sharded_placement(
                 user_factors, item_factors, user_scale, item_scale
             )
             self._shard_acct = _sharding.ShardAccounting(
-                self.plan, self._local_k
+                self.plan, self._local_k, merged_k=self.k
             )
         elif self.retrieval == "ivf":
             self._init_ivf_placement(
@@ -281,9 +289,30 @@ class BucketedScorer:
         self.warmup_executions = 0
         self._fns = {b: self._compile(b) for b in self.buckets}
         for b in self.buckets:
-            dummy_idx = jax.device_put(np.zeros(b, np.int32), self._repl)
+            dummy_idx = self._put_repl(np.zeros(b, np.int32))
             jax.block_until_ready(self._fns[b](*self._static_args, dummy_idx))
             self.warmup_executions += 1
+
+    def _put_repl(self, x: np.ndarray):
+        """Replicate a host array on the serving mesh, multi-process safe.
+
+        Pod meshes that span processes can't ``device_put`` (remote
+        shards are non-addressable); every process supplies the same host
+        copy through the shard-callback path.  SPMD contract: all
+        processes dispatch the same batches in the same order.
+        """
+        if self._pod_spans:
+            return self._shard_ctx.place(x)
+        import jax.numpy as jnp
+
+        return jax.device_put(jnp.asarray(x), self._repl)
+
+    def _fetch(self, x) -> np.ndarray:
+        """Device→host for a REPLICATED result, multi-process safe: any
+        one addressable shard of a replicated array is the whole value."""
+        if self._pod_spans:
+            return np.asarray(x.addressable_data(0))
+        return np.asarray(x)
 
     def _init_replicated_placement(
         self, user_factors, item_factors, user_scale, item_scale
@@ -442,35 +471,35 @@ class BucketedScorer:
         # items simply contributes its whole block; S·local_k ≥ k always
         # holds because S·cap_pad ≥ n_items ≥ self.k
         self._local_k = min(self.k, layout.cap_pad)
-        sc = self.ctx.submesh(plan.n_shards)
+        if self._pod:
+            # 2-D (host, data) mesh: shard s lands on host row s // G —
+            # the plan's contiguous group blocks, by construction of the
+            # process-major prefix carve
+            sc = self.ctx.pod_submesh(plan.n_shards, plan.host_groups)
+            shard_axes = (HOST_AXIS, DATA_AXIS)
+        else:
+            sc = self.ctx.submesh(plan.n_shards)
+            shard_axes = DATA_AXIS
         self._shard_ctx = sc
+        # set once during construction, read-only under traffic
+        self._pod_spans = self._pod and sc.spans_processes  # pio: ignore[race-unguarded-rebind]
         self._repl = sc.replicated()
-        rows = sc.sharding(DATA_AXIS, None)
-        flat = sc.sharding(DATA_AXIS)
-        self._U = jax.device_put(
-            jnp.asarray(np.asarray(user_factors)), self._repl
-        )
-        self._V = jax.device_put(
-            jnp.asarray(layout.take_rows(np.asarray(item_factors))), rows
+        self._U = sc.place(user_factors)
+        self._V = sc.place(
+            layout.take_rows(np.asarray(item_factors)), shard_axes, None
         )
         if self.factor_dtype == "int8":
-            self._Uscale = jax.device_put(
-                jnp.asarray(np.asarray(user_scale, np.float32)), self._repl
-            )
-            self._Vscale = jax.device_put(
-                jnp.asarray(
-                    layout.take_rows(
-                        np.asarray(item_scale, np.float32), fill=1.0
-                    )
+            self._Uscale = sc.place(np.asarray(user_scale, np.float32))
+            self._Vscale = sc.place(
+                layout.take_rows(
+                    np.asarray(item_scale, np.float32), fill=1.0
                 ),
-                rows,
+                shard_axes, None,
             )
         else:
             self._Uscale = self._Vscale = None
-        self._shard_gid = jax.device_put(jnp.asarray(layout.gid), flat)
-        self._item_pad_mask = jax.device_put(
-            jnp.asarray(layout.pad_mask), flat
-        )
+        self._shard_gid = sc.place(layout.gid, shard_axes)
+        self._item_pad_mask = sc.place(layout.pad_mask, shard_axes)
         if self.factor_dtype == "int8":
             self._static_args = (
                 self._U, self._V, self._Uscale, self._Vscale,
@@ -526,6 +555,19 @@ class BucketedScorer:
         """
         import jax.numpy as jnp
 
+        if self._pod_spans:
+            # `.at[].set` needs the whole array addressable; a pod mesh's
+            # remote shards aren't.  Documented degrade (operations.md,
+            # "Pod-scale serving"): streaming deltas don't compose with
+            # multi-process serving — the next full reload picks them up.
+            logger.warning(
+                "apply_delta_rows skipped: factors span processes on a "
+                "pod mesh; deltas apply at the next full publish/reload"
+            )
+            return {
+                "users": 0, "items": 0,
+                "compile_count": self.compile_count, "skipped": "pod",
+            }
         users = np.asarray(user_idx, np.int32).reshape(-1)
         rows = np.asarray(user_rows, np.float32).reshape(len(users), -1)
         keep = users < self.n_users
@@ -651,7 +693,7 @@ class BucketedScorer:
                     U, V, u_idx, k, item_mask=item_pad_mask, backend=be
                 )
 
-        dummy_idx = jax.device_put(np.zeros(b, np.int32), self._repl)
+        dummy_idx = self._put_repl(np.zeros(b, np.int32))
         compiled = (
             jax.jit(fn)
             .lower(*self._static_args, dummy_idx)
@@ -734,7 +776,7 @@ class BucketedScorer:
                 cand_g = jnp.swapaxes(pg, 0, 1).reshape(b, P_b * lk)
                 return merge_topk(cand_v, cand_g, k)
 
-        dummy_idx = jax.device_put(np.zeros(b, np.int32), self._repl)
+        dummy_idx = self._put_repl(np.zeros(b, np.int32))
         compiled = (
             jax.jit(fn)
             .lower(*self._static_args, dummy_idx)
@@ -774,6 +816,15 @@ class BucketedScorer:
         small leaderboard all-gather (S·B·local_k·8 bytes) — never the
         (B, n_items) score matrix.  ``merge_topk``'s (value desc, id asc)
         order makes the result bit-identical to the replicated reference.
+
+        Pod layouts (``plan.host_groups > 1``) run the merge INSIDE the
+        shard region instead: :func:`two_tier_merge_topk` gathers the G
+        on-host leaderboards over the ``data`` axis, merges, then gathers
+        only the H per-host ``(B, k)`` leaderboards over the ``host``
+        axis — the flat ``(S, B, local_k)`` collective above never forms,
+        and the cross-host wire carries ``H·B·k·8`` bytes per dispatch
+        (docs/perf_roofline.md).  Same two-key sort both tiers, so the
+        answers stay bit-identical.
         """
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -783,6 +834,8 @@ class BucketedScorer:
         be = self.backend
         S = self.plan.n_shards
         mesh = self._shard_ctx.mesh
+        pod = self._pod
+        shard_dim = (HOST_AXIS, DATA_AXIS) if pod else DATA_AXIS
 
         if self.factor_dtype == "int8":
 
@@ -791,11 +844,17 @@ class BucketedScorer:
                     U, Vl, u_idx, lk, item_mask=maskl,
                     u_scale=u_scale, v_scale=vs_l, backend=be,
                 )
-                return vals[None], jnp.take(gidl, idx)[None]
+                gids = jnp.take(gidl, idx)
+                if pod:
+                    return two_tier_merge_topk(
+                        vals, gids, k,
+                        group_axis=DATA_AXIS, host_axis=HOST_AXIS,
+                    )
+                return vals[None], gids[None]
 
             in_specs = (
-                P(), P(DATA_AXIS, None), P(), P(DATA_AXIS, None),
-                P(DATA_AXIS), P(DATA_AXIS), P(),
+                P(), P(shard_dim, None), P(), P(shard_dim, None),
+                P(shard_dim), P(shard_dim), P(),
             )
         else:
 
@@ -803,26 +862,44 @@ class BucketedScorer:
                 vals, idx = gather_score_topk(
                     U, Vl, u_idx, lk, item_mask=maskl, backend=be
                 )
-                return vals[None], jnp.take(gidl, idx)[None]
+                gids = jnp.take(gidl, idx)
+                if pod:
+                    return two_tier_merge_topk(
+                        vals, gids, k,
+                        group_axis=DATA_AXIS, host_axis=HOST_AXIS,
+                    )
+                return vals[None], gids[None]
 
             in_specs = (
-                P(), P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(),
+                P(), P(shard_dim, None), P(shard_dim), P(shard_dim), P(),
             )
-        out_specs = (
-            P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
-        )
+        if pod:
+            # the two-tier merge already replicated the final (B, k)
+            out_specs = (P(), P())
 
-        def fn(*args):
-            lv, lg = shard_map(
-                local, mesh=mesh, in_specs=in_specs, out_specs=out_specs
-            )(*args)
-            # (S, B, lk) → (B, S·lk) candidate rows; the global reshape
-            # is what pulls the leaderboards across the mesh
-            cand_v = jnp.swapaxes(lv, 0, 1).reshape(b, S * lk)
-            cand_g = jnp.swapaxes(lg, 0, 1).reshape(b, S * lk)
-            return merge_topk(cand_v, cand_g, k)
+            def fn(*args):
+                return shard_map(
+                    local, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs,
+                )(*args)
 
-        dummy_idx = jax.device_put(np.zeros(b, np.int32), self._repl)
+        else:
+            out_specs = (
+                P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+            )
+
+            def fn(*args):
+                lv, lg = shard_map(
+                    local, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs,
+                )(*args)
+                # (S, B, lk) → (B, S·lk) candidate rows; the global
+                # reshape is what pulls the leaderboards across the mesh
+                cand_v = jnp.swapaxes(lv, 0, 1).reshape(b, S * lk)
+                cand_g = jnp.swapaxes(lg, 0, 1).reshape(b, S * lk)
+                return merge_topk(cand_v, cand_g, k)
+
+        dummy_idx = self._put_repl(np.zeros(b, np.int32))
         compiled = (
             jax.jit(fn)
             .lower(*self._static_args, dummy_idx)
@@ -935,7 +1012,7 @@ class BucketedScorer:
             for t in _tracing.active_traces():
                 t.annotate(bucket=b)
             with _tracing.stage("h2d"):
-                u_dev = jax.device_put(padded, self._repl)
+                u_dev = self._put_repl(padded)
             with _profiling.trace(stage="device_compute"):
                 t0 = time.perf_counter()
                 vals, idx = self._fns[b](*self._static_args, u_dev)
@@ -947,13 +1024,15 @@ class BucketedScorer:
                 jax.block_until_ready((vals, idx))  # pio: ignore[hotpath-block-sync]
                 wall = time.perf_counter() - t0
                 self.devprof.record(b, wall)
+            idx_h = self._fetch(idx)
+            val_h = self._fetch(vals)
             with self._lock:
                 self.hits[b] += 1
                 self.queries += len(chunk)
                 self.padded_rows += b - len(chunk)
                 if self._shard_acct is not None:
                     self._shard_acct.note(
-                        np.asarray(idx)[: len(chunk), :k], b, wall,
+                        idx_h[: len(chunk), :k], b, wall,
                         self._cost_bytes.get(b, 0.0),
                     )
                 if self.retrieval == "ivf":
@@ -964,8 +1043,8 @@ class BucketedScorer:
                     )
                     self._ivf_dispatch_rows += b
             # padded tail rows are real top-k rows for user 0 — dropped here
-            idx_parts.append(np.asarray(idx)[: len(chunk), :k])
-            val_parts.append(np.asarray(vals)[: len(chunk), :k])
+            idx_parts.append(idx_h[: len(chunk), :k])
+            val_parts.append(val_h[: len(chunk), :k])
         return np.concatenate(idx_parts), np.concatenate(val_parts)
 
     # -- hot set -------------------------------------------------------------
@@ -1089,11 +1168,29 @@ class BucketedScorer:
                     "recall_at_publish": index.recall_at_publish,
                     "fingerprint": index.fingerprint,
                 }
+            pod = None
+            if self._pod:
+                pod = {
+                    "host_groups": self.plan.host_groups,
+                    "shards_per_group": self.plan.shards_per_group,
+                    "process_index": jax.process_index(),
+                    "process_count": jax.process_count(),
+                    "spans_processes": self._pod_spans,
+                    "fingerprint": self.plan.fingerprint,
+                    "cross_host_merge_bytes": (sharding or {}).get(
+                        "pod_merge_bytes", 0.0
+                    ),
+                    "cross_host_merge_seconds": (sharding or {}).get(
+                        "pod_merge_seconds", 0.0
+                    ),
+                    "dispatches": (sharding or {}).get("pod_dispatches", 0),
+                }
             return {
                 "buckets": list(self.buckets),
                 "top_k": self.k,
                 "serving_backend": self.sharding,
                 "sharding": sharding,
+                "pod": pod,
                 "retrieval_backend": self.retrieval,
                 "retrieval": retrieval,
                 "kernel": kernel,

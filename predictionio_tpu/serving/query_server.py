@@ -717,6 +717,11 @@ class QueryServer:
         # pio_ivf_*: emits only while an IVF index is live (the stats
         # block is absent under exact retrieval)
         _bridges.bridge_ivf(reg, self._fastpath_stats)
+        # pio_pod_*: emits only while a pod (multi-host-group) plan is
+        # live — the fastpath publishes a "pod" stats block then
+        _bridges.bridge_pod(
+            reg, lambda: (self._fastpath_stats() or {}).get("pod")
+        )
         # live device utilization: the scorer's cost-annotated dispatch
         # accountant, labeled with the generation it serves (the scorer —
         # and its accountant — are rebuilt on every successful reload)
@@ -1220,6 +1225,21 @@ class QueryServer:
                 plan = (fps.get("sharding") or {}).get("plan") or {}
                 if plan.get("fingerprint"):
                     body["shardingFingerprint"] = plan["fingerprint"]
+            # pod placement: advertise this replica's host group so the
+            # fleet router can fan each query to the group that owns its
+            # serving mesh (PIO_POD_GROUP pins the group in fleet
+            # deployments; an SPMD pod process defaults to its slot)
+            pod = (fps or {}).get("pod")
+            if pod:
+                group_env = os.environ.get("PIO_POD_GROUP", "")
+                body["pod"] = {
+                    "group": int(group_env) if group_env.strip()
+                    else int(pod.get("process_index") or 0),
+                    "groups": int(pod.get("host_groups") or 1),
+                    "fingerprint": pod.get("fingerprint"),
+                    "processIndex": pod.get("process_index"),
+                    "processCount": pod.get("process_count"),
+                }
             # streaming: expose the applied micro-generation epoch and
             # current staleness so the router/fleet can see exactly where
             # this replica sits in the delta sequence

@@ -44,6 +44,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import zlib
 from collections import deque
 from typing import Optional
 
@@ -96,6 +97,11 @@ class ReplicaState:
         self.samples = 0
         self.generation: Optional[int] = None
         self.delta_epoch: Optional[int] = None
+        # pod-scale serving: the host group this replica's serving mesh
+        # belongs to, as advertised on /readyz (None = not pod-sharded)
+        self.pod_group: Optional[int] = None
+        self.pod_groups: Optional[int] = None
+        self.pod_fingerprint: Optional[str] = None
         self.warm = True
         self.no_readmit_before = 0.0
         self.last_error = ""
@@ -192,7 +198,11 @@ class Router:
             "ok", "client_error", "failed", "shed", "deadline", "retries",
             "hedges_fired", "hedges_won", "hedges_denied",
             "ejections_health", "ejections_outlier", "readmissions",
+            "pod_fallback",
         )
+        # shard-aware fan-out accounting: queries routed to the host
+        # group that owns them, keyed by group id (guarded by _lock)
+        self._pod_routed: dict[int, int] = {}
         self._rl_log = RateLimitedLogger(logger)
         # streaming delta propagation acks by outcome (push_delta); a
         # plain dict guarded by _lock — outcomes come from receipt shapes,
@@ -221,12 +231,20 @@ class Router:
         frac = (now - rep.admitted_at) / self.slow_start_s
         return min(1.0, max(0.1, frac))
 
-    def _pick_locked(self, exclude: set[str]) -> Optional[ReplicaState]:
+    def _pick_locked(
+        self, exclude: set[str], group: Optional[int] = None
+    ) -> Optional[ReplicaState]:
         """Weighted least-loaded admitted replica whose breaker allows the
         call.  ``allow()`` is only consulted on a candidate we are about
-        to use, so a half-open probe slot is never burnt on a bystander."""
+        to use, so a half-open probe slot is never burnt on a bystander.
+
+        ``group`` is the pod host group that OWNS this query's serving
+        mesh (shard-aware fan-out): candidates in that group are strictly
+        preferred; when none is eligible the pick falls back fleet-wide —
+        the documented partial-group degrade, counted by the caller."""
         now = time.monotonic()
         cands = []
+        owned = []
         for rep in self._replicas:
             if rep.url in exclude or rep.state != ADMITTED:
                 continue
@@ -234,10 +252,13 @@ class Router:
                 continue
             load = (rep.inflight + 1.0) / self._weight(rep, now)
             cands.append((load, len(cands), rep))
-        cands.sort(key=lambda t: (t[0], t[1]))
-        for _, _, rep in cands:
-            if rep.breaker.allow():
-                return rep
+            if group is not None and rep.pod_group == group:
+                owned.append(cands[-1])
+        for pool in (owned, cands) if group is not None else (cands,):
+            pool.sort(key=lambda t: (t[0], t[1]))
+            for _, _, rep in pool:
+                if rep.breaker.allow():
+                    return rep
         return None
 
     def available_count(self) -> int:
@@ -336,6 +357,42 @@ class Router:
         with self._lock:
             return self._hedge_delay_ms
 
+    # -- shard-aware fan-out (pod host groups) -------------------------------
+    def _pod_group_count_locked(self) -> Optional[int]:
+        """The fleet's agreed host-group count, or None when the plan map
+        is missing/inconsistent — in which case routing degrades to the
+        plain fleet-wide broadcast pick (the documented fallback)."""
+        groups: set[int] = set()
+        fps: set[Optional[str]] = set()
+        for rep in self._replicas:
+            if rep.pod_group is None or not rep.pod_groups:
+                continue
+            groups.add(rep.pod_groups)
+            fps.add(rep.pod_fingerprint)
+        if len(groups) != 1 or len(fps) != 1:
+            # no pod fleet, or replicas advertise mismatched plans
+            # (mid-deploy fingerprint skew): don't guess ownership
+            return None
+        n = next(iter(groups))
+        return n if n > 1 else None
+
+    def _owner_group(self, body: bytes) -> Optional[int]:
+        """The host group that owns this query's serving mesh, by stable
+        user-key hash — or None when the fleet has no agreed pod map or
+        the query carries no user key (both degrade to fleet-wide)."""
+        with self._lock:
+            n = self._pod_group_count_locked()
+        if n is None:
+            return None
+        try:
+            q = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return None
+        user = q.get("user") if isinstance(q, dict) else None
+        if user is None:
+            return None
+        return zlib.crc32(str(user).encode("utf-8")) % n
+
     # -- forwarding ----------------------------------------------------------
     def _forward(
         self,
@@ -359,6 +416,24 @@ class Router:
                     b'{"message":"injected fault"}',
                     {},
                 )
+        if rep.pod_group is not None:
+            # the pod-merge hop: a forward into a host group whose
+            # cross-host leaderboard merge can tear when a member process
+            # dies mid-collective (chaos site client:pod:merge)
+            act = _faults.check("client:pod:merge")
+            if act is not None:
+                if act.latency_s:
+                    time.sleep(act.latency_s)
+                if act.kind == "drop":
+                    raise ConnectionError(
+                        "injected pod merge tear on router->group hop"
+                    )
+                if act.kind == "error":
+                    return (
+                        act.status,
+                        b'{"message":"injected pod merge fault"}',
+                        {},
+                    )
         headers = {"Content-Type": "application/json"}
         timeout = self.request_timeout_s
         if deadline is not None:
@@ -572,12 +647,22 @@ class Router:
             )
         trace_id = getattr(req.trace, "request_id", None)
         self.budget.on_attempt()
+        group = self._owner_group(req.body)
         slot = _Slot()
         with self._lock:
-            rep = self._pick_locked(slot.tried)
+            rep = self._pick_locked(slot.tried, group=group)
             if rep is not None:
                 slot.tried.add(rep.url)
                 slot.outstanding = 1
+                if group is not None:
+                    if rep.pod_group == group:
+                        self._pod_routed[group] = (
+                            self._pod_routed.get(group, 0) + 1
+                        )
+                    else:
+                        # owning group had no eligible replica: the
+                        # documented partial-group degrade to fleet-wide
+                        self.counters.inc("pod_fallback")
         if rep is None:
             self.counters.inc("shed")
             return Response(
@@ -687,6 +772,17 @@ class Router:
             de = info.get("deltaEpoch")
             if isinstance(de, int):
                 rep.delta_epoch = de
+            pod = info.get("pod")
+            if isinstance(pod, dict):
+                g, n = pod.get("group"), pod.get("groups")
+                rep.pod_group = int(g) if isinstance(g, int) else None
+                rep.pod_groups = int(n) if isinstance(n, int) else None
+                fp = pod.get("fingerprint")
+                rep.pod_fingerprint = fp if isinstance(fp, str) else None
+            else:
+                rep.pod_group = None
+                rep.pod_groups = None
+                rep.pod_fingerprint = None
             rep.warm = bool(info.get("fastpathWarm", True))
         if ok:
             rep.healthy_streak += 1
@@ -798,6 +894,7 @@ class Router:
                     "ewmaMs": r.ewma_ms,
                     "generation": r.generation,
                     "deltaEpoch": r.delta_epoch,
+                    "podGroup": r.pod_group,
                     "warm": r.warm,
                     "lastError": r.last_error or None,
                     "breaker": r.breaker.stats(),
@@ -806,9 +903,18 @@ class Router:
             ]
             hedge_delay = self._hedge_delay_ms
             rolling = self._rolling
+            pod_groups = self._pod_group_count_locked()
+            pod_routed = {str(g): n for g, n in self._pod_routed.items()}
         return {
             "status": "alive",
             "replicas": replicas,
+            "pod": {
+                "groups": pod_groups,
+                "queriesRouted": pod_routed,
+                "fallbackBroadcasts": self.counters.get("pod_fallback"),
+            }
+            if pod_groups is not None or pod_routed
+            else None,
             "available": sum(
                 1 for r in replicas if r["state"] == ADMITTED
             ),
@@ -829,11 +935,27 @@ class Router:
             "breakers": [r.breaker.stats() for r in self._replicas],
         }
 
+    def _pod_stats(self) -> Optional[dict]:
+        """The pod block for ``bridge_pod`` — None until any replica
+        advertises a pod map (the families then stay absent, same
+        presence contract as every other bridge)."""
+        with self._lock:
+            groups = self._pod_group_count_locked()
+            routed = dict(self._pod_routed)
+        if groups is None and not routed:
+            return None
+        return {
+            "host_groups": groups,
+            "queries_routed": routed,
+            "fallback_broadcasts": self.counters.get("pod_fallback"),
+        }
+
     def _register_metrics(self) -> None:
         reg = self.telemetry.registry
         _bridges.bridge_resilience(
             reg, self._resilience_stats, prefix="pio_router"
         )
+        _bridges.bridge_pod(reg, self._pod_stats)
 
         def _router_families():
             now = time.monotonic()

@@ -63,6 +63,15 @@ class ShardingPlan:
     weights the plan was balanced with; ``capacity_budget_bytes`` records
     the per-shard HBM budget the shard count was derived from (None when
     the count was given explicitly).
+
+    ``host_groups`` is the pod dimension (PR 18): the plan's shards are
+    laid out as ``host_groups × shards_per_group`` rows of a 2-D
+    ``(host, data)`` mesh, group ``g`` owning the CONTIGUOUS shard block
+    ``[g·G, (g+1)·G)`` (G = ``shards_per_group``) — exactly how the
+    prefix-carved process-major device list folds into host rows, so
+    group membership needs no extra map.  ``host_groups == 1`` is the
+    single-process layout and round-trips byte-identically with plans
+    sealed before the field existed.
     """
 
     n_shards: int
@@ -70,6 +79,7 @@ class ShardingPlan:
     strategy: str
     load_share: np.ndarray  # (n_shards,) float64, sums to 1
     capacity_budget_bytes: Optional[int] = None
+    host_groups: int = 1
 
     @property
     def n_items(self) -> int:
@@ -83,16 +93,33 @@ class ShardingPlan:
         return np.bincount(self.assignment, minlength=self.n_shards)
 
     @property
+    def shards_per_group(self) -> int:
+        """Shards per host group (the pod mesh's within-host axis size)."""
+        return self.n_shards // max(1, self.host_groups)
+
+    def group_of_shard(self, shard: int) -> int:
+        """Host group owning ``shard`` (contiguous G-sized blocks)."""
+        return int(shard) // self.shards_per_group
+
+    def group_of_item(self, item: int) -> int:
+        """Host group owning global item ``item``."""
+        return self.group_of_shard(int(self.assignment[int(item)]))
+
+    @property
     def fingerprint(self) -> str:
         """Content hash over the partition itself — the plan's identity.
 
         Published into the model manifest and surfaced through serving
         stats/metrics, so a rebalance is visible as a generation change
-        even when the factors did not move.
+        even when the factors did not move.  The host-group dimension is
+        hashed only when it is non-trivial, so every plan sealed before
+        the pod layout existed keeps its fingerprint.
         """
         h = hashlib.sha256()
         h.update(f"{_PLAN_VERSION}:{self.n_shards}:{self.strategy}:".encode())
         h.update(np.ascontiguousarray(self.assignment, np.int32).tobytes())
+        if self.host_groups > 1:
+            h.update(f":hg{self.host_groups}".encode())
         return h.hexdigest()[:16]
 
     def validate(self, n_items: Optional[int] = None) -> None:
@@ -109,6 +136,15 @@ class ShardingPlan:
         if a.size and (sizes == 0).any():
             empty = np.flatnonzero(sizes == 0).tolist()
             raise ValueError(f"plan leaves shards empty: {empty}")
+        if self.host_groups < 1:
+            raise ValueError(
+                f"host_groups must be >= 1, got {self.host_groups}"
+            )
+        if self.n_shards % self.host_groups:
+            raise ValueError(
+                f"host_groups={self.host_groups} must divide "
+                f"n_shards={self.n_shards} (equal host rows)"
+            )
 
     def to_payload(self) -> bytes:
         return pickle.dumps(
@@ -123,6 +159,7 @@ class ShardingPlan:
                     self.load_share, np.float64
                 ),
                 "capacity_budget_bytes": self.capacity_budget_bytes,
+                "host_groups": self.host_groups,
             },
             protocol=pickle.HIGHEST_PROTOCOL,
         )
@@ -136,6 +173,7 @@ class ShardingPlan:
             strategy=str(d["strategy"]),
             load_share=np.asarray(d["load_share"], np.float64),
             capacity_budget_bytes=d.get("capacity_budget_bytes"),
+            host_groups=int(d.get("host_groups", 1)),
         )
         plan.validate()
         return plan
@@ -151,6 +189,8 @@ class ShardingPlan:
             "capacity_budget_bytes": self.capacity_budget_bytes,
             "items_per_shard": sizes.tolist(),
             "load_share": [round(float(x), 6) for x in self.load_share],
+            "host_groups": self.host_groups,
+            "shards_per_group": self.shards_per_group,
         }
 
 
@@ -172,6 +212,7 @@ def build_plan(
     strategy: str = "popularity",
     capacity_budget_bytes: Optional[int] = None,
     bytes_per_item: Optional[float] = None,
+    host_groups: int = 1,
 ) -> ShardingPlan:
     """Build a plan by explicit shard count or per-shard byte budget.
 
@@ -198,6 +239,9 @@ def build_plan(
         n_shards = shard_count_for_budget(
             n_items, bytes_per_item, capacity_budget_bytes
         )
+        # a budget-derived count rounds up to fill every host row
+        if host_groups > 1 and n_shards % host_groups:
+            n_shards += host_groups - n_shards % host_groups
     n_shards = int(n_shards)
     if not 1 <= n_shards <= n_items:
         raise ValueError(
@@ -248,6 +292,7 @@ def build_plan(
         strategy=strategy,
         load_share=load_share,
         capacity_budget_bytes=capacity_budget_bytes,
+        host_groups=int(host_groups),
     )
     plan.validate(n_items)
     return plan
@@ -318,6 +363,9 @@ def plan_from_env(
     strategy = (
         os.environ.get("PIO_SHARD_STRATEGY") or "popularity"
     ).strip().lower()
+    # pod layout: PIO_POD_HOST_GROUPS=H folds the plan's shards into H
+    # host rows (must divide the shard count); 1/unset = single-host
+    host_groups = os.environ.get("PIO_POD_HOST_GROUPS", "")
     if not count.strip() and not budget.strip():
         return None
     return build_plan(
@@ -327,6 +375,7 @@ def plan_from_env(
         strategy=strategy,
         capacity_budget_bytes=int(budget) if budget.strip() else None,
         bytes_per_item=bytes_per_item,
+        host_groups=int(host_groups) if host_groups.strip() else 1,
     )
 
 
@@ -427,18 +476,29 @@ class ShardAccounting:
     threads while ``snapshot`` runs on the stats/metrics scrape thread.
     """
 
-    def __init__(self, plan: ShardingPlan, local_k: int):
+    def __init__(
+        self, plan: ShardingPlan, local_k: int,
+        merged_k: Optional[int] = None,
+    ):
         import threading
 
         self.plan = plan
         self._assign = plan.assignment
         self.local_k = int(local_k)
+        # width of each per-host leaderboard the cross-host tier ships —
+        # the compiled program's k (pod layouts only; None = flat merge)
+        self.merged_k = int(merged_k) if merged_k is not None else None
         self._lock = threading.Lock()
         n = plan.n_shards
         self.queries_routed = np.zeros(n, np.int64)  # fan-out: rows/shard
         self.result_wins = np.zeros(n, np.int64)  # top-k slots won
         self.merge_bytes = 0.0  # analytic all-gather payload
         self.merge_seconds = 0.0  # attributed share of device wall
+        # two-tier pod merge: the cross-host (H, B, k) leaderboard gather
+        # — the DCN term the roofline derivation bounds (0 when H == 1)
+        self.pod_merge_bytes = 0.0
+        self.pod_merge_seconds = 0.0
+        self.pod_dispatches = 0
 
     def note(
         self, winner_ids: np.ndarray, batch_rows: int,
@@ -447,13 +507,24 @@ class ShardAccounting:
         """Charge one dispatch: winners (B, k) global ids, real rows B."""
         ids = np.asarray(winner_ids).reshape(-1)
         ids = ids[(ids >= 0) & (ids < self._assign.shape[0])]
-        # one all-gather of S leaderboards of (B, local_k) slots each
+        # one all-gather of S leaderboards of (B, local_k) slots each —
+        # under the pod layout this is the ON-HOST tier's total across
+        # host rows (H rows × G·B·local_k slots each = S·B·local_k)
         mb = (
             float(self.plan.n_shards)
             * float(batch_rows)
             * float(self.local_k)
             * MERGE_SLOT_BYTES
         )
+        # cross-host tier: H per-host (B, merged_k) leaderboards
+        pod_mb = 0.0
+        if self.plan.host_groups > 1 and self.merged_k is not None:
+            pod_mb = (
+                float(self.plan.host_groups)
+                * float(batch_rows)
+                * float(self.merged_k)
+                * MERGE_SLOT_BYTES
+            )
         with self._lock:
             if len(ids):
                 np.add.at(
@@ -465,6 +536,13 @@ class ShardAccounting:
                 self.merge_seconds += float(device_seconds) * min(
                     1.0, mb / float(dispatch_bytes)
                 )
+            if pod_mb > 0:
+                self.pod_merge_bytes += pod_mb
+                self.pod_dispatches += 1
+                if dispatch_bytes > 0:
+                    self.pod_merge_seconds += float(device_seconds) * min(
+                        1.0, pod_mb / float(dispatch_bytes)
+                    )
 
     def snapshot(
         self, busy_fraction: Optional[float],
@@ -477,6 +555,9 @@ class ShardAccounting:
             raw_wins = self.result_wins.tolist()
             merge_bytes = self.merge_bytes
             merge_seconds = self.merge_seconds
+            pod_merge_bytes = self.pod_merge_bytes
+            pod_merge_seconds = self.pod_merge_seconds
+            pod_dispatches = self.pod_dispatches
         total = wins.sum()
         if total > 0:
             share = wins / total
@@ -497,4 +578,7 @@ class ShardAccounting:
             "resident_bytes": resident_bytes_per_shard,
             "merge_bytes": merge_bytes,
             "merge_seconds": round(merge_seconds, 6),
+            "pod_merge_bytes": pod_merge_bytes,
+            "pod_merge_seconds": round(pod_merge_seconds, 6),
+            "pod_dispatches": pod_dispatches,
         }

@@ -791,6 +791,7 @@ def cmd_shards(args) -> int:
             strategy=args.strategy,
             capacity_budget_bytes=args.budget,
             bytes_per_item=bytes_per_item,
+            host_groups=getattr(args, "host_groups", 1),
         )
     except ValueError as e:
         return _die(f"cannot build plan: {e}")
@@ -801,6 +802,7 @@ def cmd_shards(args) -> int:
         "n_shards": plan.n_shards,
         "strategy": plan.strategy,
         "fingerprint": plan.fingerprint,
+        "host_groups": plan.host_groups,
     }
     tmp = f"{maps_path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
@@ -1350,6 +1352,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["norm", "uniform"],
                    help="popularity weights: item-factor L2 norms (the "
                    "traffic proxy) or uniform")
+    x.add_argument("--host-groups", type=int, default=1,
+                   help="pod host groups: shards partition into this many "
+                   "contiguous groups, one per serving host (two-tier "
+                   "merge; must divide the shard count)")
     x.set_defaults(func=cmd_shards)
 
     sp = sub.add_parser(

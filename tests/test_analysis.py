@@ -822,6 +822,70 @@ def test_collective_rules_positive_and_negative(tmp_path):
     assert not any("helper_clean" in s for s in host)
 
 
+POD_COLLECTIVE_FIXTURE = {
+    "serving/pod.py": """\
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def pod_clean(xs, ctx):
+            sc = ctx.pod_submesh(4, 2)
+            def body(v, g):
+                return two_tier_merge_topk(
+                    v, g, 10, group_axis="data", host_axis="host")
+            f = shard_map(body, mesh=sc.mesh,
+                          in_specs=(P(("host", "data"), None),
+                                    P(("host", "data"), None)),
+                          out_specs=(P(), P()))
+            return f(xs, xs)
+
+        def pod_bad_mesh(xs, ctx):
+            sc = ctx.pod_submesh(4, 2)
+            def body(v):
+                return jax.lax.psum(v, "model")
+            f = shard_map(body, mesh=sc.mesh, in_specs=(P("model"),),
+                          out_specs=P("model"))
+            return f(xs)
+
+        def pod_bad_tier_axis(xs, mesh):
+            def body(v, g):
+                return two_tier_merge_topk(
+                    v, g, 10, group_axis="data", host_axis="ring")
+            f = shard_map(body, mesh=mesh,
+                          in_specs=(P(("host", "data"), None),
+                                    P(("host", "data"), None)),
+                          out_specs=(P(), P()))
+            return f(xs, xs)
+
+        def pod_degenerate(v, g):
+            return two_tier_merge_topk(
+                v, g, 10, group_axis="data", host_axis="data")
+
+        def pod_dynamic(v, g, ax):
+            return two_tier_merge_topk(v, g, 10, group_axis=ax,
+                                       host_axis=ax)
+    """,
+}
+
+
+def test_collective_pod_two_tier_rules(tmp_path):
+    root = make_repo(tmp_path, POD_COLLECTIVE_FIXTURE)
+    rep = run(root, analyzers=["collective"])
+    # pod_submesh meshes resolve to {host, data}: the spec axis "model"
+    # in pod_bad_mesh is flagged against them
+    assert symbols(rep, "collective-mesh-axis") == {"model"}
+    # two_tier_merge_topk's axis kwargs are collective axis uses: the
+    # unbound "ring" is caught, the in-scope pod_clean call is not
+    assert symbols(rep, "collective-unknown-axis") == {"ring"}
+    # group_axis == host_axis collapses the two tiers onto one axis
+    degen = by_rule(rep, "collective-two-tier-axes")
+    assert [f.symbol for f in degen] == ["data"]
+    # dynamic axis params are skipped, never guessed
+    assert not any(
+        f.line and "pod_dynamic" in f.message for f in rep.findings
+    )
+    assert not any("pod_clean" in f.message for f in rep.findings)
+
+
 # -- races: explicit acquire()/release() --------------------------------------
 
 

@@ -1,0 +1,383 @@
+"""Pod-scale serving: 2-process pod mesh bit-identity + shard-aware router.
+
+Two proofs the pod tentpole rests on:
+
+* **Bit-identical two-tier merge across processes** — a 2-process
+  ``jax.distributed`` CPU mesh (2 virtual devices per process, Gloo
+  collectives) serves a 4-shard / 2-host-group plan through the real
+  ``BucketedScorer``; its global top-k must be BIT-identical to the
+  single-process replicated reference computed by the parent, for every
+  bucket rung × factor dtype — and the measured cross-host merge traffic
+  must equal the ``H·B·k·8`` derivation in docs/perf_roofline.md exactly
+  (the flat ``S·B·local_k`` collective never crosses hosts).
+* **Shard-aware router fan-out** — replicas advertising a pod host group
+  on /readyz get exactly their own group's queries (stable user-key
+  hash), the ``client:pod:merge`` chaos site fires on the group hop, and
+  a kill -9 of one host group's process degrades that group to
+  fleet-wide fallback with ZERO client-visible failures until it heals.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_USERS, N_ITEMS, RANK, K = 40, 320, 8, 10
+SEED = 11
+DTYPES = ("f32", "bf16", "int8")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_until(pred, timeout=20.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- part 1: 2-process pod mesh vs single-process replicated reference --------
+
+# same preamble contract as tests/test_distributed.py: 2 virtual CPU
+# devices per process, platform pinned at the config level
+POD_WORKER = f"""
+import os, sys
+sys.path.insert(0, {REPO!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json
+import numpy as np
+from predictionio_tpu.parallel import distributed
+
+assert distributed.initialize()
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.ops.quantize import quantize_factors
+from predictionio_tpu.serving import sharding as _sharding
+from predictionio_tpu.serving.fastpath import BucketedScorer
+
+N_USERS, N_ITEMS, RANK, K = {N_USERS}, {N_ITEMS}, {RANK}, {K}
+ctx = MeshContext.create()
+assert ctx.n_devices == 4, ctx.n_devices
+rng = np.random.default_rng({SEED})
+U = rng.standard_normal((N_USERS, RANK)).astype(np.float32)
+V = rng.standard_normal((N_ITEMS, RANK)).astype(np.float32)
+batches = [rng.integers(0, N_USERS, n).astype(np.int32) for n in (1, 13)]
+plan = _sharding.build_plan(N_ITEMS, 4, host_groups=2)
+assert plan.host_groups == 2 and plan.shards_per_group == 2
+out = {{}}
+for dtype in {DTYPES!r}:
+    Uq, us = quantize_factors(U, dtype)
+    Vq, vs = quantize_factors(V, dtype)
+    sc = BucketedScorer(
+        ctx, Uq, Vq, max_k=K, buckets=(1, 8), factor_dtype=dtype,
+        user_scale=us, item_scale=vs, sharding="sharded", plan=plan,
+    )
+    assert sc._pod and sc._pod_spans
+    cells = []
+    for users in batches:
+        idx, vals = sc.score_topk(users, K)
+        cells.append({{
+            "idx": np.asarray(idx).tolist(),
+            "vals": np.asarray(vals, np.float64).tolist(),
+        }})
+    pod = sc.stats()["pod"]
+    # the (H, B, k) tier-2 gather is the ONLY cross-host traffic:
+    # H*b*k*8 bytes per dispatch over rungs b=1 once and b=8 twice
+    expect = 2 * 1 * K * 8 + 2 * (2 * 8 * K * 8)
+    assert pod["cross_host_merge_bytes"] == expect, (pod, expect)
+    assert pod["dispatches"] == 3, pod
+    assert pod["host_groups"] == 2 and pod["process_count"] == 2
+    out[dtype] = {{"cells": cells,
+                  "pod_bytes": pod["cross_host_merge_bytes"]}}
+print("POD_RESULT " + json.dumps(out))
+print("POD_OK", distributed.process_index())
+"""
+
+
+def _launch_worker(script_path, pid: int, port: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.update(
+        PIO_COORDINATOR=f"127.0.0.1:{port}",
+        PIO_NUM_PROCESSES="2",
+        PIO_PROCESS_ID=str(pid),
+    )
+    return subprocess.Popen(
+        [sys.executable, str(script_path)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _run_worker_pair(script_path, timeout=180) -> list[str]:
+    port = free_port()
+    procs = [
+        _launch_worker(script_path, 0, port),
+        _launch_worker(script_path, 1, port),
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+            assert p.returncode == 0, out
+    finally:
+        for p in procs:  # never leak workers stuck in the rendezvous
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def _replicated_reference() -> dict:
+    """Single-process replicated answers for the worker's exact inputs."""
+    from predictionio_tpu.ops.quantize import quantize_factors
+    from predictionio_tpu.parallel.mesh import MeshContext
+    from predictionio_tpu.serving.fastpath import BucketedScorer
+
+    rng = np.random.default_rng(SEED)
+    U = rng.standard_normal((N_USERS, RANK)).astype(np.float32)
+    V = rng.standard_normal((N_ITEMS, RANK)).astype(np.float32)
+    batches = [rng.integers(0, N_USERS, n).astype(np.int32) for n in (1, 13)]
+    ctx = MeshContext.create()
+    ref = {}
+    for dtype in DTYPES:
+        Uq, us = quantize_factors(U, dtype)
+        Vq, vs = quantize_factors(V, dtype)
+        sc = BucketedScorer(
+            ctx, Uq, Vq, max_k=K, buckets=(1, 8), factor_dtype=dtype,
+            user_scale=us, item_scale=vs, sharding="replicated",
+        )
+        ref[dtype] = [sc.score_topk(users, K) for users in batches]
+    return ref
+
+
+def test_pod_mesh_bit_identical_to_replicated_reference(tmp_path):
+    """2-process pod serving == single-process replicated, bit for bit,
+    across bucket rungs × factor dtypes — and the measured cross-host
+    merge moved (H, B, k) entries, not (S, B, local_k)."""
+    script = tmp_path / "pod_worker.py"
+    script.write_text(POD_WORKER)
+    outs = _run_worker_pair(script)
+    ref = _replicated_reference()
+    for out in outs:
+        assert "POD_OK" in out, out
+        line = next(
+            ln for ln in out.splitlines() if ln.startswith("POD_RESULT ")
+        )
+        got = json.loads(line[len("POD_RESULT "):])
+        for dtype in DTYPES:
+            # tier-2 bytes: S/H × local_k/k smaller than the flat gather
+            flat = 4 * (1 + 8 + 8) * K * 8.0
+            assert got[dtype]["pod_bytes"] * 2 == flat
+            for cell, (ref_idx, ref_vals) in zip(
+                got[dtype]["cells"], ref[dtype]
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(cell["idx"], np.int32), ref_idx,
+                    err_msg=f"indices diverge for {dtype}",
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(cell["vals"], np.float64),
+                    np.asarray(ref_vals, np.float64),
+                    err_msg=f"values diverge for {dtype}",
+                )
+
+
+# -- part 2: shard-aware router + chaos ---------------------------------------
+
+POD_STUB = """
+import os
+from predictionio_tpu.common.http import HttpService, json_response
+
+svc = HttpService("podstub")
+GROUP = int(os.environ["POD_STUB_GROUP"])
+GROUPS = int(os.environ["POD_STUB_GROUPS"])
+
+@svc.route("GET", r"/readyz")
+def readyz(req):
+    return json_response(200, {
+        "status": "ready", "generation": 1, "fastpathWarm": True,
+        "draining": False,
+        "pod": {"group": GROUP, "groups": GROUPS, "fingerprint": "fp-pod",
+                "processIndex": GROUP, "processCount": GROUPS},
+    })
+
+@svc.route("POST", r"/queries\\.json")
+def queries(req):
+    return json_response(200, {"group": GROUP})
+
+svc.start("127.0.0.1", int(os.environ["POD_STUB_PORT"]))
+svc.serve_forever()
+"""
+
+
+def _spawn_stub(port: int, group: int, groups: int = 2) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
+    env.update(
+        POD_STUB_PORT=str(port),
+        POD_STUB_GROUP=str(group),
+        POD_STUB_GROUPS=str(groups),
+    )
+    return subprocess.Popen([sys.executable, "-c", POD_STUB], env=env)
+
+
+def _post_query(base: str, user: str):
+    req = urllib.request.Request(
+        base + "/queries.json",
+        data=json.dumps({"user": user, "num": 3}).encode(),
+        method="POST", headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _users_for_group(group: int, groups: int = 2, n: int = 5) -> list[str]:
+    out = []
+    i = 0
+    while len(out) < n:
+        u = f"u{i}"
+        if zlib.crc32(u.encode()) % groups == group:
+            out.append(u)
+        i += 1
+    return out
+
+
+@pytest.fixture()
+def pod_fleet():
+    """Two stub replica subprocesses (one per host group) + a router."""
+    from predictionio_tpu.serving.router import Router
+
+    ports = [free_port(), free_port()]
+    procs = {g: _spawn_stub(ports[g], g) for g in (0, 1)}
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    router = Router(urls, telemetry=False)
+    router.health_interval_ms = 50.0
+    router.probe_timeout_ms = 500.0
+    router.eject_after = 2
+    router.readmit_after = 2
+    router.slow_start_s = 0.2
+    port = router.start("127.0.0.1", 0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        yield router, base, procs, ports
+    finally:
+        router.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
+def _pod_ready(router, groups=2):
+    st = router.stats()
+    pod = st.get("pod")
+    return (
+        st["available"] == 2 and pod is not None
+        and pod.get("groups") == groups
+    )
+
+
+def test_router_fans_each_query_to_owning_group(pod_fleet):
+    router, base, _procs, _ports = pod_fleet
+    wait_until(lambda: _pod_ready(router), msg="pod map on both replicas")
+    for group in (0, 1):
+        for user in _users_for_group(group):
+            status, body = _post_query(base, user)
+            assert status == 200
+            # exactly ONE host group saw the query — and it is the owner
+            assert body["group"] == group, (user, body)
+    pod = router.stats()["pod"]
+    assert pod["queriesRouted"] == {"0": 5, "1": 5}
+    assert pod["fallbackBroadcasts"] == 0
+    # no user key → no owner group → plain fleet-wide pick: neither the
+    # per-group counters nor the fallback counter move
+    req = urllib.request.Request(
+        base + "/queries.json", data=b'{"num": 3}', method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    pod = router.stats()["pod"]
+    assert pod["queriesRouted"] == {"0": 5, "1": 5}
+    assert pod["fallbackBroadcasts"] == 0
+
+
+def test_pod_merge_fault_site_fires_and_retries_absorb(pod_fleet):
+    from predictionio_tpu.common import faults
+
+    router, base, _procs, _ports = pod_fleet
+    wait_until(lambda: _pod_ready(router), msg="pod map on both replicas")
+    plan = faults.FaultPlan(
+        faults.parse_spec("site=client:pod:merge,kind=drop,times=1"),
+        seed=7,
+    )
+    faults.install(plan)
+    try:
+        for user in _users_for_group(0, n=3):
+            status, body = _post_query(base, user)
+            assert status == 200  # free transport retries absorb the tear
+        fired = plan.stats()["rules"][0]["fired"]
+        assert fired == 1, plan.stats()
+    finally:
+        faults.clear()
+
+
+def test_host_group_loss_degrades_without_client_failures(pod_fleet):
+    """kill -9 of host group 1's process: its queries fall back
+    fleet-wide with zero client-visible failures; once the process heals
+    the router returns to group-affine routing."""
+    router, base, procs, ports = pod_fleet
+    wait_until(lambda: _pod_ready(router), msg="pod map on both replicas")
+    g1_users = _users_for_group(1, n=8)
+    status, body = _post_query(base, g1_users[0])
+    assert status == 200 and body["group"] == 1
+
+    procs[1].kill()  # SIGKILL: the kill -9 contract, no drain
+    procs[1].wait(10)
+    # mid-outage load: every query must still answer 200 — refused
+    # connects retry free onto group 0 (the documented degrade)
+    for user in g1_users:
+        status, body = _post_query(base, user)
+        assert status == 200, (user, status)
+        assert body["group"] == 0  # absorbed by the surviving group
+    wait_until(
+        lambda: router.stats()["available"] == 1,
+        msg="dead replica ejected",
+    )
+    baseline_fb = router.stats()["pod"]["fallbackBroadcasts"]
+    for user in g1_users[:3]:
+        status, body = _post_query(base, user)
+        assert status == 200 and body["group"] == 0
+    # ejected owner → picks degrade fleet-wide and are counted
+    assert router.stats()["pod"]["fallbackBroadcasts"] >= baseline_fb + 3
+
+    # heal: same port, same group identity; readmission via the health
+    # gate, then group-affine routing resumes
+    procs[1] = _spawn_stub(ports[1], 1)
+
+    def _healed():
+        try:
+            status, body = _post_query(base, g1_users[0])
+        except (urllib.error.URLError, OSError):
+            return False
+        return status == 200 and body["group"] == 1
+
+    wait_until(_healed, timeout=30.0, msg="group 1 back in rotation")

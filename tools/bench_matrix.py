@@ -35,7 +35,7 @@ _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
          "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0",
          "BENCH_KERNEL": "0", "BENCH_TRAIN_KERNEL": "0", "BENCH_FLEET": "0",
          "BENCH_ELASTIC": "0", "BENCH_SHARDED": "0", "BENCH_RETRIEVAL": "0",
-         "BENCH_FRESHNESS": "0"}
+         "BENCH_FRESHNESS": "0", "BENCH_POD": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -301,6 +301,25 @@ def main() -> int:
             ) if shd_plans else None,
             "gate_pass": shd.get("gate_pass"),
         },
+    }
+    # pod-serving gate (ISSUE 18): a real 2-process jax.distributed CPU
+    # mesh serves a 2-host-group plan through the two-tier merge — the
+    # pod answers must be bit-identical to the single-process replicated
+    # reference AND the measured cross-host merge traffic must stay <=
+    # the H*B*k*8 derivation in docs/perf_roofline.md (the flat
+    # S*B*local_k collective rides along for the reduction factor)
+    podb = (primary.get("multichip") or {}).get("pod_serving") or {}
+    artifact["multichip"]["pod_serving"] = {
+        "processes": podb.get("processes"),
+        "host_groups": podb.get("host_groups"),
+        "n_shards": podb.get("n_shards"),
+        "exact_match": podb.get("exact_match"),
+        "cross_host_merge_bytes": podb.get("cross_host_merge_bytes"),
+        "cross_host_merge_bytes_derived": podb.get(
+            "cross_host_merge_bytes_derived"
+        ),
+        "reduction_factor": podb.get("reduction_factor"),
+        "gate_pass": podb.get("gate_pass"),
     }
     # IVF retrieval gate (ISSUE 16): at the default nprobe the pruned scan
     # must keep recall@10 >= 0.95 against the exact scorer while touching
