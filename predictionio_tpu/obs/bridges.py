@@ -434,8 +434,9 @@ def bridge_pod(
         if isinstance(routed, dict):
             fams.append(_fam(
                 "pio_pod_queries_routed_total", "counter",
-                "Queries the router fanned to their owning host group "
-                "(shard-aware routing; one group per query).",
+                "Attempts the router fanned to their owning host group "
+                "(shard-aware routing; primaries, retries, and hedges "
+                "all keep — and count against — the query's affinity).",
                 [("", (("group", str(g)),), _num(n))
                  for g, n in sorted(routed.items(), key=lambda kv:
                                     str(kv[0]))],
@@ -443,9 +444,9 @@ def bridge_pod(
         if "fallback_broadcasts" in pod:
             fams.append(_fam(
                 "pio_pod_fallback_broadcasts_total", "counter",
-                "Queries routed fleet-wide because the owning group had "
-                "no eligible replica or the plan map was missing — the "
-                "documented degrade path.",
+                "Attempts routed fleet-wide because the owning group had "
+                "no eligible replica — the documented degrade path "
+                "(retried and hedged attempts included).",
                 [("", (), _num(pod.get("fallback_broadcasts")))],
             ))
         if "cross_host_merge_bytes" in pod:

@@ -132,6 +132,24 @@ def make_mesh(
     return Mesh(dev_array, tuple(axes.keys()))
 
 
+def misaligned_pod_row(
+    devices: Sequence[Any], host_groups: int
+) -> Optional[int]:
+    """First host row whose devices span more than one process, else None.
+
+    The pod alignment precondition (:meth:`MeshContext.pod_submesh`):
+    folding ``devices`` row-major into ``host_groups`` rows, every row
+    must be process-pure for the two-tier merge's on-host tier to stay
+    off DCN.  ``len(devices)`` must be divisible by ``host_groups``.
+    """
+    per_row = len(devices) // host_groups
+    for g in range(host_groups):
+        row = devices[g * per_row:(g + 1) * per_row]
+        if len({d.process_index for d in row}) > 1:
+            return g
+    return None
+
+
 @dataclasses.dataclass
 class MeshContext:
     """The compute context handed through the DASE pipeline (replaces ``sc``).
@@ -197,12 +215,17 @@ class MeshContext:
         """A 2-D ``(host, data)`` context over the first ``n_shards`` devices.
 
         The pod-scale serving layout: ``host_groups`` rows of
-        ``n_shards // host_groups`` devices each.  Because the prefix
-        carve keeps ``jax.devices()``'s process-major order, each host row
-        is ICI-local whenever ``n_shards / host_groups`` divides the
-        per-process device count — the on-host tier of the two-tier
-        leaderboard merge then never touches DCN, and only the tiny
-        ``(H, B, k)`` host-axis gather crosses processes.
+        ``n_shards // host_groups`` devices each.  The prefix carve keeps
+        ``jax.devices()``'s process-major order, so each host row is
+        ICI-local exactly when every row's devices live in one process —
+        the on-host tier of the two-tier leaderboard merge then never
+        touches DCN, and only the tiny ``(H, B, k)`` host-axis gather
+        crosses processes.  That alignment is a correctness precondition,
+        not a hint: a row straddling a process boundary would silently
+        turn the "on-host" tier into DCN traffic and break the contiguous
+        ``group_of_shard`` ↔ process mapping the router keys ownership
+        on, so a misaligned carve is rejected here (callers fall back to
+        the flat single-tier merge).
         """
         if host_groups < 1 or n_shards % host_groups:
             raise ValueError(
@@ -214,6 +237,17 @@ class MeshContext:
                 f"{self.mesh.size}-device mesh"
             )
         devs = list(self.mesh.devices.flat)[:n_shards]
+        bad = misaligned_pod_row(devs, host_groups)
+        if bad is not None:
+            per_row = n_shards // host_groups
+            raise ValueError(
+                f"pod host row {bad} spans processes: {host_groups} host "
+                f"groups of {per_row} shards do not align with the "
+                "per-process device layout, so the on-host merge tier "
+                "would cross DCN and group ownership would disagree with "
+                "device placement — pick host_groups so each row's "
+                "devices share one process"
+            )
         return MeshContext(
             mesh=make_mesh(
                 axes={HOST_AXIS: host_groups,

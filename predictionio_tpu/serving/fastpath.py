@@ -222,8 +222,11 @@ class BucketedScorer:
             self._init_sharded_placement(
                 user_factors, item_factors, user_scale, item_scale
             )
+            # merged_k drives the cross-host tier-2 byte accounting; the
+            # flat merge (including a rejected pod carve) has no tier 2
             self._shard_acct = _sharding.ShardAccounting(
-                self.plan, self._local_k, merged_k=self.k
+                self.plan, self._local_k,
+                merged_k=self.k if self._pod else None,
             )
         elif self.retrieval == "ivf":
             self._init_ivf_placement(
@@ -474,10 +477,21 @@ class BucketedScorer:
         if self._pod:
             # 2-D (host, data) mesh: shard s lands on host row s // G —
             # the plan's contiguous group blocks, by construction of the
-            # process-major prefix carve
-            sc = self.ctx.pod_submesh(plan.n_shards, plan.host_groups)
-            shard_axes = (HOST_AXIS, DATA_AXIS)
-        else:
+            # process-major prefix carve.  A carve whose host rows do not
+            # align with process boundaries is rejected by pod_submesh
+            # (the two-tier merge's locality and ownership claims would
+            # both be false); serving degrades to the flat merge.
+            try:
+                sc = self.ctx.pod_submesh(plan.n_shards, plan.host_groups)
+                shard_axes = (HOST_AXIS, DATA_AXIS)
+            except ValueError as e:
+                logger.warning(
+                    "pod layout rejected (%s); serving the flat "
+                    "single-tier merge instead", e,
+                )
+                # construction-time rebind, before the scorer is shared
+                self._pod = False  # pio: ignore[race-unguarded-rebind]
+        if not self._pod:
             sc = self.ctx.submesh(plan.n_shards)
             shard_axes = DATA_AXIS
         self._shard_ctx = sc

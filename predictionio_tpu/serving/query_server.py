@@ -245,6 +245,11 @@ class QueryServer:
         # cached answers from the previous generation can never validate
         # even if clear() were to race a concurrent put
         self._serving_gen = 0
+        # (generation, spans) memo behind _pod_lockstep(): whether the
+        # live fastpath's pod mesh spans jax.distributed processes — such
+        # a replica can only be driven in SPMD lockstep and must refuse
+        # independently routed queries (guarded by _lock)
+        self._pod_lockstep_memo: Optional[tuple] = None
         # on-demand profiler (POST /debug/profile): one capture at a time
         # (jax.profiler is process-global), bounded window, counted
         self._profile_lock = threading.Lock()
@@ -661,6 +666,32 @@ class QueryServer:
             if s is not None:
                 return s
         return None
+
+    def _pod_lockstep(self) -> bool:
+        """True when the live fastpath's pod mesh spans processes.
+
+        Such a mesh is bound by the SPMD dispatch contract (every
+        ``jax.distributed`` process must execute the same compiled
+        program for the same batch in the same order — the cross-host
+        leaderboard gather is a collective ALL peers participate in), so
+        this replica cannot answer queries routed to it alone: the first
+        independent dispatch would wedge the whole pod in the collective.
+        ``/queries.json`` refuses with 503 and ``/readyz`` reports
+        not-ready instead; lockstep drivers (the pod bench harness, batch
+        scoring run identically on every process) call the scorer
+        directly and are unaffected.  Memoized per serving generation —
+        the flag is a property of the deployed scorer's placement.
+        """
+        with self._lock:
+            gen = self._serving_gen
+            memo = self._pod_lockstep_memo
+        if memo is not None and memo[0] == gen:
+            return memo[1]
+        pod = (self._fastpath_stats() or {}).get("pod") or {}
+        spans = bool(pod.get("spans_processes"))
+        with self._lock:
+            self._pod_lockstep_memo = (gen, spans)
+        return spans
 
     def _event_cache_stats(self) -> Optional[dict]:
         """First deployed algorithm's ServingEventCache stats, if any (the
@@ -1228,17 +1259,25 @@ class QueryServer:
             # pod placement: advertise this replica's host group so the
             # fleet router can fan each query to the group that owns its
             # serving mesh (PIO_POD_GROUP pins the group in fleet
-            # deployments; an SPMD pod process defaults to its slot)
+            # deployments of SELF-CONTAINED replicas).  A mesh that spans
+            # jax.distributed processes is lockstep-only — advertising a
+            # routable group would invite per-group batches its SPMD
+            # peers never dispatch, wedging the cross-host collective —
+            # so `group` is withheld (null) and the replica reports
+            # not-ready below; PIO_POD_GROUP cannot override this.
             pod = (fps or {}).get("pod")
+            pod_spans = bool((pod or {}).get("spans_processes"))
             if pod:
                 group_env = os.environ.get("PIO_POD_GROUP", "")
                 body["pod"] = {
-                    "group": int(group_env) if group_env.strip()
+                    "group": None if pod_spans
+                    else int(group_env) if group_env.strip()
                     else int(pod.get("process_index") or 0),
                     "groups": int(pod.get("host_groups") or 1),
                     "fingerprint": pod.get("fingerprint"),
                     "processIndex": pod.get("process_index"),
                     "processCount": pod.get("process_count"),
+                    "spansProcesses": pod_spans,
                 }
             # streaming: expose the applied micro-generation epoch and
             # current staleness so the router/fleet can see exactly where
@@ -1280,6 +1319,11 @@ class QueryServer:
             if inflight >= self.max_inflight:
                 body["status"] = "overloaded"
                 return Response(status=503, body=body, headers=retry)
+            if pod_spans:
+                # never admitted into a routed fleet: this process can
+                # only score in SPMD lockstep with its pod peers
+                body["status"] = "pod mesh spans processes (lockstep only)"
+                return Response(status=503, body=body, headers=retry)
             body["status"] = "ready"
             return json_response(200, body)
 
@@ -1295,6 +1339,18 @@ class QueryServer:
                     status=503,
                     body={"message": "server draining; retry against "
                           "another instance"},
+                    headers={"Retry-After": f"{self.retry_after_s():g}"},
+                )
+            if self._pod_lockstep():
+                # refusing beats deadlocking: one process of a
+                # process-spanning pod mesh cannot dispatch alone — its
+                # SPMD peers would never join the cross-host collective
+                return Response(
+                    status=503,
+                    body={"message": "pod mesh spans processes: queries "
+                          "must be dispatched in SPMD lockstep on every "
+                          "process, not routed to one — serve through "
+                          "self-contained host-local replicas instead"},
                     headers={"Retry-After": f"{self.retry_after_s():g}"},
                 )
             # admission control: beyond max_inflight, queueing only adds

@@ -119,7 +119,7 @@ class _Slot:
     attempt threads (primary + optional hedge)."""
 
     __slots__ = ("event", "lock", "result", "winner_hedged", "outstanding",
-                 "failure", "tried")
+                 "failure", "tried", "group")
 
     def __init__(self):
         self.event = threading.Event()
@@ -129,6 +129,9 @@ class _Slot:
         self.outstanding = 0
         self.failure = None         # last losing (status, body, headers)
         self.tried: set[str] = set()
+        # pod owner group of this query (None = no affinity): retries
+        # and hedges re-apply the same affinity the primary pick had
+        self.group: Optional[int] = None
 
 
 class Router:
@@ -376,6 +379,22 @@ class Router:
         n = next(iter(groups))
         return n if n > 1 else None
 
+    def _note_pod_pick_locked(
+        self, rep: ReplicaState, group: Optional[int]
+    ) -> None:
+        """Charge one attempt's pick against the pod fan-out accounting
+        (caller holds ``_lock``).  EVERY attempt that carries an owner
+        group — primary, retry, hedge — is counted: owner-group hit in
+        ``pio_pod_queries_routed_total{group}``, off-owner pick in
+        ``pio_pod_fallback_broadcasts_total`` (the documented degrade the
+        runbook tells operators to watch)."""
+        if group is None:
+            return
+        if rep.pod_group == group:
+            self._pod_routed[group] = self._pod_routed.get(group, 0) + 1
+        else:
+            self.counters.inc("pod_fallback")
+
     def _owner_group(self, body: bytes) -> Optional[int]:
         """The host group that owns this query's serving mesh, by stable
         user-key hash — or None when the fleet has no agreed pod map or
@@ -600,7 +619,12 @@ class Router:
             with slot.lock:
                 tried = set(slot.tried)
             with self._lock:
-                nxt = self._pick_locked(tried)
+                # retries keep the primary pick's group affinity (and its
+                # routed/fallback accounting) — slot.group is written once
+                # before the first attempt spawns, so this read is safe
+                nxt = self._pick_locked(tried, group=slot.group)
+                if nxt is not None:
+                    self._note_pod_pick_locked(nxt, slot.group)
             if nxt is None:
                 self._abandon(slot, last)
                 return
@@ -649,20 +673,16 @@ class Router:
         self.budget.on_attempt()
         group = self._owner_group(req.body)
         slot = _Slot()
+        slot.group = group
         with self._lock:
             rep = self._pick_locked(slot.tried, group=group)
             if rep is not None:
                 slot.tried.add(rep.url)
                 slot.outstanding = 1
-                if group is not None:
-                    if rep.pod_group == group:
-                        self._pod_routed[group] = (
-                            self._pod_routed.get(group, 0) + 1
-                        )
-                    else:
-                        # owning group had no eligible replica: the
-                        # documented partial-group degrade to fleet-wide
-                        self.counters.inc("pod_fallback")
+                # owner-group hit or the documented partial-group
+                # degrade to fleet-wide — same accounting on every
+                # attempt (retries and hedges included)
+                self._note_pod_pick_locked(rep, group)
         if rep is None:
             self.counters.inc("shed")
             return Response(
@@ -679,12 +699,15 @@ class Router:
                 with slot.lock:
                     tried = set(slot.tried)
                 with self._lock:
-                    hrep = self._pick_locked(tried)
+                    # hedges keep the query's group affinity too
+                    hrep = self._pick_locked(tried, group=group)
                 if hrep is not None:
                     if self.budget.take():
                         with slot.lock:
                             slot.tried.add(hrep.url)
                             slot.outstanding += 1
+                        with self._lock:
+                            self._note_pod_pick_locked(hrep, group)
                         self.counters.inc("hedges_fired")
                         self._spawn_attempt(
                             slot, hrep, req.body, deadline, True, trace_id
@@ -773,13 +796,18 @@ class Router:
             if isinstance(de, int):
                 rep.delta_epoch = de
             pod = info.get("pod")
-            if isinstance(pod, dict):
+            if isinstance(pod, dict) and not pod.get("spansProcesses"):
                 g, n = pod.get("group"), pod.get("groups")
                 rep.pod_group = int(g) if isinstance(g, int) else None
                 rep.pod_groups = int(n) if isinstance(n, int) else None
                 fp = pod.get("fingerprint")
                 rep.pod_fingerprint = fp if isinstance(fp, str) else None
             else:
+                # a replica whose serving mesh spans processes is bound
+                # by the SPMD lockstep contract: every peer process must
+                # dispatch the same batch, so routing it one group's
+                # queries would wedge the cross-host collective — never
+                # treat it as a routable pod group member
                 rep.pod_group = None
                 rep.pod_groups = None
                 rep.pod_fingerprint = None

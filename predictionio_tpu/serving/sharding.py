@@ -239,10 +239,28 @@ def build_plan(
         n_shards = shard_count_for_budget(
             n_items, bytes_per_item, capacity_budget_bytes
         )
-        # a budget-derived count rounds up to fill every host row
+        # a budget-derived count rounds up to fill every host row.  When
+        # the round-up overruns a catalog the budget alone could serve
+        # (small catalog, many host rows), the POD knob — not the budget
+        # — made the publish unservable: say so, rather than letting the
+        # generic shard-count bound below obscure the cause.
         if host_groups > 1 and n_shards % host_groups:
-            n_shards += host_groups - n_shards % host_groups
+            rounded = n_shards + host_groups - n_shards % host_groups
+            if rounded > n_items >= n_shards:
+                raise ValueError(
+                    f"host_groups={host_groups} (PIO_POD_HOST_GROUPS) "
+                    f"cannot be filled from this catalog: the "
+                    f"budget-derived shard count {n_shards} rounds up "
+                    f"to {rounded} > n_items={n_items} — lower "
+                    "PIO_POD_HOST_GROUPS or raise the budget"
+                )
+            n_shards = rounded
     n_shards = int(n_shards)
+    if host_groups > 1 and n_shards % host_groups:
+        raise ValueError(
+            f"host_groups={host_groups} (PIO_POD_HOST_GROUPS) must "
+            f"divide n_shards={n_shards}: pod host rows must be equal"
+        )
     if not 1 <= n_shards <= n_items:
         raise ValueError(
             f"n_shards={n_shards} outside [1, n_items={n_items}]"

@@ -1,10 +1,14 @@
 """Mesh/sharding layer tests over the virtual 8-device CPU platform."""
 
+import types
+
 import jax
 import numpy as np
 import pytest
 
-from predictionio_tpu.parallel.mesh import MeshContext, make_mesh, pad_to_multiple
+from predictionio_tpu.parallel.mesh import (
+    MeshContext, make_mesh, misaligned_pod_row, pad_to_multiple,
+)
 
 
 def test_eight_virtual_devices():
@@ -48,6 +52,36 @@ def test_pad_to_multiple():
     assert pad_to_multiple(8, 8) == 8
     assert pad_to_multiple(9, 8) == 16
     assert pad_to_multiple(0, 4) == 4
+
+
+def _fake_devices(process_of: list[int]):
+    """Duck-typed devices with only what alignment checking reads."""
+    return [types.SimpleNamespace(process_index=p) for p in process_of]
+
+
+def test_misaligned_pod_row_detection():
+    # 2 processes × 2 devices, 2 rows of 2: process-pure → aligned
+    assert misaligned_pod_row(_fake_devices([0, 0, 1, 1]), 2) is None
+    # 4 rows of 1 device are always pure
+    assert misaligned_pod_row(_fake_devices([0, 0, 1, 1]), 4) is None
+    # single process: any grouping is trivially aligned
+    assert misaligned_pod_row(_fake_devices([0] * 6), 3) is None
+    # 2 processes × 3 devices folded into 3 rows of 2: the middle row
+    # [p0d2, p1d0] straddles the process boundary
+    assert misaligned_pod_row(_fake_devices([0, 0, 0, 1, 1, 1]), 3) == 1
+    # one fat row spanning both processes
+    assert misaligned_pod_row(_fake_devices([0, 0, 1, 1]), 1) == 0
+
+
+def test_pod_submesh_single_process_aligned():
+    """On a single-process mesh every carve is process-pure: the pod
+    submesh builds and carries the (host, data) axes."""
+    ctx = MeshContext.create()
+    sc = ctx.pod_submesh(4, 2)
+    assert sc.mesh.shape == {"host": 2, "data": 2}
+    assert not sc.spans_processes
+    with pytest.raises(ValueError):
+        ctx.pod_submesh(4, 3)  # host_groups must divide n_shards
 
 
 def test_sharded_computation_psum():

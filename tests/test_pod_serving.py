@@ -208,6 +208,7 @@ from predictionio_tpu.common.http import HttpService, json_response
 svc = HttpService("podstub")
 GROUP = int(os.environ["POD_STUB_GROUP"])
 GROUPS = int(os.environ["POD_STUB_GROUPS"])
+SPANS = os.environ.get("POD_STUB_SPANS") == "1"
 
 @svc.route("GET", r"/readyz")
 def readyz(req):
@@ -215,7 +216,8 @@ def readyz(req):
         "status": "ready", "generation": 1, "fastpathWarm": True,
         "draining": False,
         "pod": {"group": GROUP, "groups": GROUPS, "fingerprint": "fp-pod",
-                "processIndex": GROUP, "processCount": GROUPS},
+                "processIndex": GROUP, "processCount": GROUPS,
+                "spansProcesses": SPANS},
     })
 
 @svc.route("POST", r"/queries\\.json")
@@ -227,7 +229,9 @@ svc.serve_forever()
 """
 
 
-def _spawn_stub(port: int, group: int, groups: int = 2) -> subprocess.Popen:
+def _spawn_stub(
+    port: int, group: int, groups: int = 2, spans: bool = False
+) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
@@ -236,6 +240,7 @@ def _spawn_stub(port: int, group: int, groups: int = 2) -> subprocess.Popen:
         POD_STUB_PORT=str(port),
         POD_STUB_GROUP=str(group),
         POD_STUB_GROUPS=str(groups),
+        POD_STUB_SPANS="1" if spans else "0",
     )
     return subprocess.Popen([sys.executable, "-c", POD_STUB], env=env)
 
@@ -358,6 +363,13 @@ def test_host_group_loss_degrades_without_client_failures(pod_fleet):
         status, body = _post_query(base, user)
         assert status == 200, (user, status)
         assert body["group"] == 0  # absorbed by the surviving group
+    # retries keep the primary pick's group affinity: every mid-outage
+    # query lands off-owner at least once (either its retry pick after
+    # the dead owner, or — once the breaker opens — its primary pick),
+    # and each such attempt is charged to the fallback counter
+    assert (
+        router.stats()["pod"]["fallbackBroadcasts"] >= len(g1_users)
+    ), router.stats()["pod"]
     wait_until(
         lambda: router.stats()["available"] == 1,
         msg="dead replica ejected",
@@ -381,3 +393,48 @@ def test_host_group_loss_degrades_without_client_failures(pod_fleet):
         return status == 200 and body["group"] == 1
 
     wait_until(_healed, timeout=30.0, msg="group 1 back in rotation")
+
+
+def test_router_ignores_process_spanning_pod_adverts():
+    """A replica whose pod mesh spans ``jax.distributed`` processes can
+    only score in SPMD lockstep — routing any single query to one of its
+    processes would deadlock the cross-host collective.  The router must
+    drop such pod adverts and serve the fleet as plain replicas."""
+    from predictionio_tpu.serving.router import Router
+
+    ports = [free_port(), free_port()]
+    procs = {g: _spawn_stub(ports[g], g, spans=True) for g in (0, 1)}
+    router = Router(
+        [f"http://127.0.0.1:{p}" for p in ports], telemetry=False
+    )
+    router.health_interval_ms = 50.0
+    router.probe_timeout_ms = 500.0
+    port = router.start("127.0.0.1", 0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        # `available` alone races startup (replicas begin admitted);
+        # `generation` starts None and is only ever set from a
+        # successful probe round-trip against a live stub
+        wait_until(
+            lambda: router.stats()["available"] == 2
+            and all(
+                r["generation"] is not None
+                for r in router.stats()["replicas"]
+            ),
+            msg="both replicas probed",
+        )
+        assert router.stats()["pod"] is None
+        # queries still answer — as a plain fleet, never group-affine
+        for user in _users_for_group(0) + _users_for_group(1):
+            status, _body = _post_query(base, user)
+            assert status == 200
+        assert router.stats()["pod"] is None
+        assert all(
+            r["podGroup"] is None
+            for r in router.stats()["replicas"]
+        )
+    finally:
+        router.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()

@@ -188,6 +188,44 @@ class TestQueryServer:
             qs.stop()
             es.stop()
 
+    def test_process_spanning_pod_mesh_refuses_routed_traffic(self, trained):
+        """A replica whose pod mesh spans jax.distributed processes is
+        lockstep-only: /readyz reports not-ready with the group advert
+        withheld, and /queries.json refuses rather than dispatching a
+        collective its SPMD peers would never join."""
+        qs = QueryServer(
+            trained["engine"], storage=trained["storage"], ctx=trained["ctx"]
+        )
+        port = qs.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            status, _res = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 1}
+            )
+            assert status == 200  # sanity: serves before the override
+            qs._fastpath_stats = lambda: {
+                "pod": {
+                    "host_groups": 2,
+                    "spans_processes": True,
+                    "fingerprint": "fp-pod",
+                    "process_index": 0,
+                    "process_count": 2,
+                }
+            }
+            qs._pod_lockstep_memo = None  # drop the memoized verdict
+            status, body = call("GET", base + "/readyz")
+            assert status == 503
+            assert "lockstep" in body["status"]
+            assert body["pod"]["group"] is None
+            assert body["pod"]["spansProcesses"] is True
+            status, body = call(
+                "POST", base + "/queries.json", {"user": "u1", "num": 1}
+            )
+            assert status == 503
+            assert "lockstep" in body["message"]
+        finally:
+            qs.stop()
+
 
 class TestMicroBatching:
     def test_concurrent_queries_batched_and_identical(self, trained):

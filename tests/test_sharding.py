@@ -97,6 +97,26 @@ class TestBuildPlan:
         assert p.capacity_budget_bytes == 2500
         assert p.shard_sizes().max() * 32.0 <= 2500
 
+    def test_budget_rounding_fills_host_rows_without_overrunning_catalog(self):
+        # derived count 4 rounds up to 6 for 3 host rows — still <= items
+        p = sharding.build_plan(
+            300, capacity_budget_bytes=2500, bytes_per_item=32.0,
+            host_groups=3,
+        )
+        assert p.n_shards == 6 and p.host_groups == 3
+        # tiny catalog, many host rows: derived count 7 is servable, but
+        # rounding up for 5 rows overruns the 7-item catalog — the error
+        # names the pod knob, not the generic shard-count bound
+        with pytest.raises(ValueError, match="PIO_POD_HOST_GROUPS"):
+            sharding.build_plan(
+                7, capacity_budget_bytes=4, bytes_per_item=4.0,
+                host_groups=5,
+            )
+
+    def test_explicit_count_indivisible_by_host_groups_names_knob(self):
+        with pytest.raises(ValueError, match="PIO_POD_HOST_GROUPS"):
+            sharding.build_plan(100, 10, host_groups=3)
+
     def test_fingerprint_stable_and_assignment_sensitive(self):
         w = np.arange(50, dtype=np.float64)
         a = sharding.build_plan(50, 2, weights=w)
