@@ -2399,6 +2399,257 @@ def _retrieval_bench(ctx, platform) -> dict:
     }
 
 
+def _tenant_bench(ctx) -> dict:
+    """Multi-tenant QoS + composed-pipeline evidence (ISSUE 19).
+
+    Two gates in one block:
+
+    * ``noisy_neighbor`` — two tenants behind one query server; tenant
+      ``alpha`` drives far past its qps quota while ``beta`` sends a
+      modest stream.  The contract: alpha's overage is shed with 503s
+      ATTRIBUTED to its quota (token bucket, ``Retry-After``), alpha's
+      admitted requests all succeed, and beta sees zero errors, zero
+      sheds, and a p99 inside its SLO — one tenant's saturation must
+      not tax another's latency.
+    * ``pipeline`` — the same query answered two ways on a bench-sized
+      clustered catalog: single-stage exact ALS (full-catalog matvec +
+      top-k) vs the composed IVF-retrieval → fused-ALS-ranking
+      pipeline.  The gate is the ISSUE's bar: the pipeline beats exact
+      on scores/s (catalog rows ranked per wall-second) at <= 1.5x the
+      exact path's p99.
+    """
+    import shutil
+    import tempfile
+    import threading
+    import types
+
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import Event
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.data.storage.sqlite import close_db
+    from predictionio_tpu.models.als import ALSModel, ALSScorer
+    from predictionio_tpu.ops import ivf as ivf_mod
+    from predictionio_tpu.serving.pipeline import (
+        PipelineConfig, StageSpec, build_recommendation_stages,
+    )
+    from predictionio_tpu.serving.query_server import QueryServer
+    from predictionio_tpu.serving.tenancy import TenantRegistry, TenantSpec
+    from predictionio_tpu.templates.recommendation import (
+        Query, RecommendationEngine,
+    )
+    from predictionio_tpu.tools.loadtest import run_loadtest
+
+    quota_qps = float(os.environ.get("BENCH_TENANT_QUOTA_QPS", 25.0))
+    slo_ms = float(os.environ.get("BENCH_TENANT_SLO_MS", 500.0))
+    out: dict = {}
+
+    # -- noisy neighbor: quota shed + isolation ---------------------------
+    tmp = tempfile.mkdtemp(prefix="pio-tenant-bench-")
+    src = "TENB"
+    storage_env = {
+        f"PIO_STORAGE_SOURCES_{src}_TYPE": "sqlite",
+        f"PIO_STORAGE_SOURCES_{src}_PATH": os.path.join(
+            tmp, "events.sqlite"
+        ),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+    }
+    old_basedir = os.environ.get("PIO_FS_BASEDIR")
+    os.environ["PIO_FS_BASEDIR"] = os.path.join(tmp, "fs")
+    qs = None
+    try:
+        storage = Storage(env=storage_env)
+        store_mod.set_storage(storage)
+        app_id = storage.get_meta_data_apps().insert(App(0, "tenantbench"))
+        le = storage.get_l_events()
+        le.init(app_id)
+        rng = np.random.default_rng(19)
+        events = []
+        for u in range(20):
+            for i in rng.choice(16, size=6, replace=False):
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties={"rating": float(rng.integers(1, 6))},
+                ))
+        le.batch_insert(events, app_id)
+        engine = RecommendationEngine.apply()
+        ep = engine.params_from_variant({
+            "datasource": {"params": {"appName": "tenantbench"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 3}}
+            ],
+        })
+        run_train(engine, ep, "e", storage=storage, ctx=ctx)
+
+        registry = TenantRegistry(
+            [
+                TenantSpec("alpha", "bench-key-alpha", weight=1.0,
+                           quota_qps=quota_qps, slo_ms=slo_ms),
+                TenantSpec("beta", "bench-key-beta", weight=1.0,
+                           slo_ms=slo_ms),
+            ],
+            total_inflight=64,
+        )
+        qs = QueryServer(
+            engine, storage=storage, ctx=ctx, telemetry=False,
+            tenants=registry,
+        )
+        port = qs.start("127.0.0.1", 0)
+        url = f"http://127.0.0.1:{port}"
+        users = [f"u{i}" for i in range(20)]
+
+        results: dict = {}
+
+        def drive(name, key, requests, concurrency):
+            results[name] = run_loadtest(
+                url, {"num": 3, "accessKey": key},
+                requests=requests, concurrency=concurrency,
+                samples={"user": users},
+            )
+
+        # alpha floods (8 closed-loop workers against a ~sub-ms model
+        # burn the banked burst tokens in well under a second); beta
+        # keeps a polite trickle going the whole time
+        ta = threading.Thread(
+            target=drive, args=("alpha", "bench-key-alpha", 400, 8),
+        )
+        tb = threading.Thread(
+            target=drive, args=("beta", "bench-key-beta", 120, 2),
+        )
+        ta.start()
+        tb.start()
+        ta.join()
+        tb.join()
+
+        tstats = registry.stats()
+        alpha, beta = results["alpha"], results["beta"]
+        noisy = {
+            "quota_qps": quota_qps,
+            "slo_ms": slo_ms,
+            "alpha": {
+                "ok": alpha["ok"], "errors": alpha["errors"],
+                "shed": alpha["shed"],
+                "shed_reasons": tstats["alpha"]["shed"],
+                "admitted": tstats["alpha"]["admitted"],
+            },
+            "beta": {
+                "ok": beta["ok"], "errors": beta["errors"],
+                "shed": beta["shed"], "p99_ms": beta["p99Ms"],
+                "slo_violations": tstats["beta"]["slo_violations"],
+            },
+            "gate_pass": bool(
+                alpha["shed"] > 0
+                and tstats["alpha"]["shed"]["quota"] > 0
+                and alpha["errors"] == 0
+                and beta["errors"] == 0
+                and beta["shed"] == 0
+                and (beta["p99Ms"] or 0.0) <= slo_ms
+            ),
+        }
+    finally:
+        if qs is not None:
+            qs.stop()
+        store_mod.set_storage(None)
+        close_db(os.path.join(tmp, "events.sqlite"))
+        if old_basedir is None:
+            os.environ.pop("PIO_FS_BASEDIR", None)
+        else:
+            os.environ["PIO_FS_BASEDIR"] = old_basedir
+        shutil.rmtree(tmp, ignore_errors=True)
+    out["noisy_neighbor"] = noisy
+
+    # -- composed pipeline vs single-stage exact --------------------------
+    n_items = int(os.environ.get("BENCH_TENANT_ITEMS", 32768))
+    rank = int(os.environ.get("BENCH_TENANT_RANK", 16))
+    n_queries = int(os.environ.get("BENCH_TENANT_QUERIES", 300))
+    n_users = 64
+    nlist = 64
+    rng = np.random.default_rng(23)
+    # clustered catalog (same regime as the IVF gate): retrieval prunes
+    # structure, and real item-factor matrices have it
+    centers = (rng.normal(size=(nlist, rank)) * 4.0).astype(np.float32)
+    item_cluster = rng.integers(0, nlist, size=n_items)
+    V = (
+        centers[item_cluster] + rng.normal(size=(n_items, rank)) * 0.25
+    ).astype(np.float32)
+    u_cluster = rng.integers(0, nlist, size=n_users)
+    U = (
+        centers[u_cluster] + rng.normal(size=(n_users, rank)) * 0.25
+    ).astype(np.float32)
+    model = ALSModel(
+        user_factors=U,
+        item_factors=V,
+        user_map=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_map=BiMap({f"i{i}": i for i in range(n_items)}),
+        ivf_index=ivf_mod.build_index(V, nlist),
+    )
+    scorer = ALSScorer(ctx, model)  # bench catalog < HOST_THRESHOLD: host path
+    config = PipelineConfig(
+        name="bench-two-stage",
+        stages=(
+            StageSpec("retrieve", "retrieval", 0.4,
+                      params=(("candidates", 512),)),
+            StageSpec("rank", "ranking", 0.6),
+        ),
+    )
+    pipe = build_recommendation_stages(
+        config, types.SimpleNamespace(_scorer=lambda m: scorer), model,
+    )
+    if pipe is None:
+        raise RuntimeError("pipeline failed to bind the bench model")
+
+    def drive_exact(i: int) -> None:
+        scorer.recommend(i % n_users, 10)
+
+    def drive_pipeline(i: int) -> None:
+        pred, meta = pipe.run_pipeline(Query(user=f"u{i % n_users}", num=10))
+        if meta.get("degraded"):
+            raise RuntimeError("pipeline degraded with no deadline set")
+
+    def timed(fn) -> tuple:
+        for i in range(20):  # warm caches / lazy allocations
+            fn(i)
+        lats = []
+        t0 = time.perf_counter()
+        for i in range(n_queries):
+            t1 = time.perf_counter()
+            fn(i)
+            lats.append(time.perf_counter() - t1)
+        total = time.perf_counter() - t0
+        lats.sort()
+        p99 = lats[min(int(0.99 * len(lats)), len(lats) - 1)] * 1e3
+        return n_queries / total, p99
+
+    exact_qps, exact_p99 = timed(drive_exact)
+    pipe_qps, pipe_p99 = timed(drive_pipeline)
+    out["pipeline"] = {
+        "n_items": n_items,
+        "rank": rank,
+        "queries": n_queries,
+        "fingerprint": config.fingerprint,
+        "exact_qps": round(exact_qps, 1),
+        "exact_scores_per_s": round(exact_qps * n_items, 1),
+        "exact_p99_ms": round(exact_p99, 3),
+        "pipeline_qps": round(pipe_qps, 1),
+        "pipeline_scores_per_s": round(pipe_qps * n_items, 1),
+        "pipeline_p99_ms": round(pipe_p99, 3),
+        "speedup": round(pipe_qps / exact_qps, 3),
+        "stage_stats": pipe.stats()["stages"],
+        "gate_pass": bool(
+            pipe_qps > exact_qps and pipe_p99 <= 1.5 * exact_p99
+        ),
+    }
+    out["gate_pass"] = bool(
+        out["noisy_neighbor"]["gate_pass"] and out["pipeline"]["gate_pass"]
+    )
+    return out
+
+
 def main() -> None:
     # BENCH_PLATFORM=cpu skips the (slow) tunnel probe for local iteration
     forced_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
@@ -2645,6 +2896,14 @@ def main() -> None:
             print(f"WARNING: retrieval bench failed: {e}", file=sys.stderr)
             retrieval = {"error": str(e)}
         print(f"INFO: retrieval: {retrieval}", file=sys.stderr)
+    tenant = None
+    if os.environ.get("BENCH_TENANT", "1") != "0":
+        try:
+            tenant = _tenant_bench(ctx)
+        except Exception as e:  # the tenancy gate must never kill the artifact
+            print(f"WARNING: tenant bench failed: {e}", file=sys.stderr)
+            tenant = {"error": str(e)}
+        print(f"INFO: tenant: {tenant}", file=sys.stderr)
     record = {
         "metric": "als_train_events_per_sec_per_chip",
         "value": round(value, 1),
@@ -2699,6 +2958,8 @@ def main() -> None:
             record["multichip"]["pod_serving"] = pod
     if retrieval is not None:
         record["retrieval"] = retrieval
+    if tenant is not None:
+        record["tenant"] = tenant
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

@@ -42,8 +42,11 @@ R_BLOCKING = rule(
     "or a cv.wait",
 )
 
-# dispatch modules: every function is hot unless exempted
-_HOT_MODULES = ("batching.py", "fastpath.py", "sharding.py")
+# dispatch modules: every function is hot unless exempted.
+# tenancy.py admission and pipeline.py stage execution run under every
+# multi-tenant / composed-pipeline query — as hot as the batcher
+_HOT_MODULES = ("batching.py", "fastpath.py", "sharding.py",
+                "tenancy.py", "pipeline.py")
 # ops modules on the serving dispatch path: probe selection and the
 # pruned scan in ivf.py run under every cache-miss query
 _HOT_OPS_MODULES = ("ivf.py",)
@@ -62,7 +65,19 @@ _EXEMPT_FUNCS = {"__init__", "_compile", "stats", "stop", "close",
                  # or `pio ivf rebuild` time, never under a dispatch
                  # (resolve_retrieval/default_nprobe stay in scope)
                  "train_kmeans", "build_index", "index_from_env",
-                 "measure_recall", "save_index", "load_index"}
+                 "measure_recall", "save_index", "load_index",
+                 # tenancy.py / pipeline.py config + publish-time
+                 # machinery: registry/pipeline construction, the
+                 # sealed-blob envelope and env loading run at deploy
+                 # time, never under a dispatch (admit/release/
+                 # record_result/run_pipeline/stage runners stay in
+                 # scope)
+                 "tenants_from_env", "registry_from_config",
+                 "pipeline_from_env", "save_pipeline", "load_pipeline",
+                 "from_dict", "to_dict",
+                 # the injected stall IS the fault being modeled: a
+                 # chaos-configured slow pipeline stage
+                 "_fault_latency"}
 # worker-loop functions checked across the wider threaded scope
 # (_health_loop/_monitor_loop/_control_loop: the router's probe pacer,
 # the fleet supervisor's child watcher, and the autoscaler's decision

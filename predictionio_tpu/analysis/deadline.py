@@ -85,6 +85,11 @@ R_STALE = rule(
 _ENTRY_PREFIXES = (
     "recommend", "score", "predict", "query", "handle", "serve",
     "lookup", "rank", "push_delta", "catchup",
+    # pipeline plane (serving/pipeline.py): run_pipeline splits the
+    # ambient budget into per-stage slices and each stage_* handler
+    # executes under its slice — both must honor the deadline contract
+    # like any other serving entry
+    "run_pipeline", "stage_",
 )
 # the storage client the ISSUE names: its DAO surface has no request
 # verbs but the query path flows straight through it

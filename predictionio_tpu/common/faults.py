@@ -43,6 +43,17 @@ SEEDED schedule, at named fault SITES compiled into the service planes:
   a replica dies after receiving a delta but before recording it
   applied; on restart it reloads clean base factors and catches up from
   the sealed log (epoch fencing makes the replay exactly-once).
+* ``client:tenant:<tenant>`` — consulted by the query server after
+  tenant authentication but before admission (latency / simulated 5xx
+  attributed to that tenant): models ONE tenant's traffic going bad.
+  The chaos suite fires it to prove tenant isolation — the faulted
+  tenant's circuit breaker trips and its SLO counters move while every
+  other tenant's breaker stays closed and its p99 stays in SLO.
+* ``server:pipeline:<stage>`` — consulted by ``serving/pipeline.py``
+  at each stage boundary before the stage runs (latency / error):
+  a slow or failing ranking stage must degrade the response to the
+  retrieval-only answer (``degraded:true``) inside the stage's share
+  of the request deadline, never blow the end-to-end SLO.
 
 Nothing fires unless a plan is installed — the shim is one ``is None``
 check on the hot path.  Installation is programmatic (:func:`install`,

@@ -1967,14 +1967,28 @@ class ALSScorer:
                 jnp.asarray(candidate_items is not None),
             )
             vals, idx = np.asarray(vals)[:k], np.asarray(idx)[:k]
+        elif candidate_items is not None:
+            # candidate path on host: gather only the candidate rows and
+            # rank those — a pipeline retrieval stage hands us a few
+            # hundred ids, and a full-catalog matvec + dense mask would
+            # throw the candidate pruning away
+            cand = np.asarray(candidate_items, np.int64)
+            if exclude_items is not None and len(exclude_items):
+                cand = cand[~np.isin(cand, np.asarray(exclude_items, np.int64))]
+            m = self.model
+            if len(cand) == 0:
+                return np.zeros(0, np.int64), np.zeros(0, np.float32)
+            sub = m.item_factors[cand] @ m.user_factors[user_idx]
+            kk = min(k, len(cand))
+            pick = np.argpartition(-sub, kk - 1)[:kk]
+            order = np.argsort(-sub[pick])
+            pick = pick[order]
+            idx = cand[pick]
+            vals = sub[pick]
         else:
             mask = np.zeros(self._n_items_pad, bool)
             if exclude_items is not None and len(exclude_items):
                 mask[np.asarray(exclude_items, np.int64)] = True
-            if candidate_items is not None:
-                keep = np.zeros(self._n_items_pad, bool)
-                keep[np.asarray(candidate_items, np.int64)] = True
-                mask |= ~keep
             m = self.model
             scores = m.user_factors[user_idx] @ m.item_factors.T
             scores = np.where(mask[: self.n_items], -1e30, scores)

@@ -690,6 +690,130 @@ def bridge_result_cache(
     registry.register_collector(collect)
 
 
+def bridge_tenancy(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """TenantRegistry ``stats()`` → pio_tenant_* families, labeled by
+    tenant (and variant for the A/B comparison series).  Emits nothing
+    when no registry is installed; label cardinality is bounded by the
+    registry's tenant/variant config, under PIO_METRICS_MAX_SERIES."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        req_samples, err_samples, lat_samples = [], [], []
+        shed_samples, inflight, caps, tokens = [], [], [], []
+        slo, brk, pressure = [], [], []
+        for tid, t in sorted(s.items()):
+            lab = (("tenant", tid),)
+            inflight.append(("", lab, _num(t.get("inflight"))))
+            caps.append(("", lab, _num(t.get("cap"))))
+            if t.get("tokens") is not None:
+                tokens.append(("", lab, _num(t.get("tokens"))))
+            slo.append(("", lab, _num(t.get("slo_violations"))))
+            brk.append((
+                "", lab,
+                BREAKER_STATE_VALUES.get(str(t.get("breaker")), 0.0),
+            ))
+            cap = max(1.0, _num(t.get("cap"), 1.0))
+            pressure.append(
+                ("", lab, min(1.0, _num(t.get("inflight")) / cap))
+            )
+            for reason, n in sorted((t.get("shed") or {}).items()):
+                shed_samples.append(
+                    ("", (("tenant", tid), ("reason", reason)), _num(n))
+                )
+            for vname, v in sorted((t.get("variants") or {}).items()):
+                vlab = (("tenant", tid), ("variant", vname))
+                req_samples.append(("", vlab, _num(v.get("requests"))))
+                err_samples.append(("", vlab, _num(v.get("errors"))))
+                for q in ("p50", "p99"):
+                    lat_samples.append((
+                        "", vlab + (("quantile", q),),
+                        _num(v.get(f"{q}_ms")),
+                    ))
+        return [
+            _fam("pio_tenant_requests_total", "counter",
+                 "Requests accounted per tenant and A/B variant.",
+                 req_samples),
+            _fam("pio_tenant_errors_total", "counter",
+                 "Server-error (5xx) responses per tenant and variant — "
+                 "the same events that feed the tenant's breaker.",
+                 err_samples),
+            _fam("pio_tenant_latency_ms", "gauge",
+                 "Per-tenant, per-variant latency quantiles (the online "
+                 "A/B comparison surface).", lat_samples),
+            _fam("pio_tenant_shed_total", "counter",
+                 "Per-tenant sheds by reason: quota (token bucket dry), "
+                 "inflight (fair-share cap), breaker (tenant breaker "
+                 "open).", shed_samples),
+            _fam("pio_tenant_inflight", "gauge",
+                 "Requests currently inside this tenant's admission "
+                 "slice.", inflight),
+            _fam("pio_tenant_inflight_cap", "gauge",
+                 "Fair-share inflight cap (weight-proportional share of "
+                 "the server gate, x PIO_TENANT_BURST).", caps),
+            _fam("pio_tenant_quota_tokens", "gauge",
+                 "Token-bucket balance for quota'd tenants (absent when "
+                 "no quota_qps is set).", tokens),
+            _fam("pio_tenant_slo_violations_total", "counter",
+                 "Successful answers that exceeded the tenant's slo_ms.",
+                 slo),
+            _fam("pio_tenant_breaker_state", "gauge",
+                 "Tenant circuit-breaker state (0 closed / 1 open / 2 "
+                 "half-open).", brk),
+            _fam("pio_tenant_pressure", "gauge",
+                 "Inflight saturation against the fair-share cap — the "
+                 "autoscaler's per-tenant signal.", pressure),
+        ]
+
+    registry.register_collector(collect)
+
+
+def bridge_pipeline(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """PipelineEngine ``stats()`` → pio_pipeline_* families, labeled by
+    stage.  Emits nothing while no pipeline is bound."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        runs, overruns, errors, lat, frac = [], [], [], [], []
+        for name, st in sorted((s.get("stages") or {}).items()):
+            lab = (("stage", name),)
+            runs.append(("", lab, _num(st.get("runs"))))
+            overruns.append(("", lab, _num(st.get("overruns"))))
+            errors.append(("", lab, _num(st.get("errors"))))
+            frac.append(("", lab, _num(st.get("budget_fraction"))))
+            for q in ("p50", "p99"):
+                lat.append((
+                    "", lab + (("quantile", q),), _num(st.get(f"{q}_ms")),
+                ))
+        return [
+            _fam("pio_pipeline_stage_runs_total", "counter",
+                 "Completed runs per pipeline stage.", runs),
+            _fam("pio_pipeline_stage_overruns_total", "counter",
+                 "Stage executions that exceeded their share of the "
+                 "request deadline.", overruns),
+            _fam("pio_pipeline_stage_errors_total", "counter",
+                 "Stage executions that raised.", errors),
+            _fam("pio_pipeline_stage_latency_ms", "gauge",
+                 "Per-stage latency quantiles.", lat),
+            _fam("pio_pipeline_stage_budget_fraction", "gauge",
+                 "Configured share of the request deadline per stage.",
+                 frac),
+            _fam("pio_pipeline_degraded_total", "counter",
+                 "Answers degraded to the retrieval-only result after a "
+                 "later stage overran or failed.",
+                 [("", (), _num(s.get("degraded_total")))]),
+        ]
+
+    registry.register_collector(collect)
+
+
 def bridge_event_cache(
     registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
 ) -> None:

@@ -164,20 +164,29 @@ class Autoscaler:
             if self.busy_enabled
             else 0.0
         )
+        signals = {
+            "inflight": round(min(1.0, sig["inflight"] / capacity), 4),
+            "shed": round(
+                min(1.0, shed_rate / self.shed_ref)
+                if self.shed_ref > 0 else 0.0, 4,
+            ),
+            "hedge": round(
+                min(1.0, hedge_rate / self.hedge_ref)
+                if self.hedge_ref > 0 else 0.0, 4,
+            ),
+            "busy": round(min(1.0, busy), 4),
+        }
+        if "tenantPressure" in sig:
+            # hottest tenant's inflight saturation against its fair-share
+            # cap (multi-tenant router).  Quota sheds are deliberately NOT
+            # in this signal: a tenant over its paid quota must be shed,
+            # not have the fleet scaled up for it.
+            signals["tenant"] = round(
+                min(1.0, float(sig["tenantPressure"])), 4
+            )
         return {
             "rolling": bool(sig.get("rolling")),
-            "signals": {
-                "inflight": round(min(1.0, sig["inflight"] / capacity), 4),
-                "shed": round(
-                    min(1.0, shed_rate / self.shed_ref)
-                    if self.shed_ref > 0 else 0.0, 4,
-                ),
-                "hedge": round(
-                    min(1.0, hedge_rate / self.hedge_ref)
-                    if self.hedge_ref > 0 else 0.0, 4,
-                ),
-                "busy": round(min(1.0, busy), 4),
-            },
+            "signals": signals,
         }
 
     # -- the control decision ------------------------------------------------

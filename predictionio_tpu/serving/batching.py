@@ -147,7 +147,11 @@ class MicroBatcher:
         ``key`` opts this query into single-flight coalescing: when an
         identical key is already in flight, this call attaches to the
         leader's pending and shares its result instead of occupying a
-        device row of its own.
+        device row of its own.  The key the server passes is the
+        tenant-NAMESPACED canonical fingerprint (tenant + variant +
+        engine instance prefix — ``result_cache.canonical_fingerprint``):
+        two tenants sending byte-identical bodies must never share a
+        leader slot, or one tenant's answer leaks to the other.
         """
         now = time.perf_counter()
         with self._arr_lock:

@@ -30,6 +30,7 @@ import time
 import urllib.request
 from typing import Any, Optional
 
+from predictionio_tpu.common import faults as _faults
 from predictionio_tpu.common.http import HttpService, Request, Response, json_response
 from predictionio_tpu.common.resilience import (
     DEADLINE_HEADER,
@@ -57,11 +58,19 @@ from predictionio_tpu.obs import bridges as _bridges
 from predictionio_tpu.obs import devprof as _devprof
 from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.serving.pipeline import (
+    build_pipeline_engine,
+    pipeline_from_env,
+)
 from predictionio_tpu.serving.result_cache import (
     canonical_fingerprint,
     coalesce_from_env,
     entity_ids_from,
     result_cache_from_env,
+)
+from predictionio_tpu.serving.tenancy import (
+    extract_access_key,
+    tenants_from_env,
 )
 from predictionio_tpu.utils.profiling import LatencyHistogram
 
@@ -150,6 +159,8 @@ class QueryServer:
         telemetry: bool = True,
         result_cache=None,
         coalesce: Optional[bool] = None,
+        tenants=None,
+        pipeline=None,
     ):
         self.engine = engine
         self.storage = storage or Storage.instance()
@@ -262,6 +273,21 @@ class QueryServer:
         # is what makes PIO_STREAMING=0 bit-identical to the pre-streaming
         # server
         self._streaming: Optional[dict] = None
+        # multi-tenancy (ISSUE 19): tenant registry consulted on every
+        # /queries.json — access-key auth, fair-share admission ahead of
+        # the server-wide gate, per-tenant breakers/SLO/variant metrics.
+        # None (PIO_TENANTS unset) keeps the open single-tenant server.
+        self._tenants = (
+            tenants_from_env(total_inflight=self.max_inflight)
+            if tenants is None else tenants
+        )
+        # composed retrieval→ranking pipeline: the sealed config loads
+        # here; the ENGINE binds against the deployed model on every
+        # generation swap (_note_generation_swap).  None ⇒ single-stage.
+        self._pipeline_config = (
+            pipeline_from_env() if pipeline is None else pipeline
+        )
+        self._pipeline_engine = None
         self._register_routes()
         self.reload()
         self._batcher = None
@@ -364,8 +390,26 @@ class QueryServer:
         # from reload/cold-start threads, so it takes the server lock
         with self._lock:
             self._serving_gen += 1
+            deployed = self._deployed
         if self._result_cache is not None:
             self._result_cache.clear()
+        # re-bind the pipeline against the new generation's algorithms/
+        # models; a config that cannot bind (template without the ALS
+        # surface) degrades to single-stage serving, never fails a swap
+        if self._pipeline_config is not None and deployed is not None:
+            try:
+                engine = build_pipeline_engine(
+                    self._pipeline_config, deployed.algorithms,
+                    deployed.models,
+                )
+            except Exception:
+                engine = None
+                self._rl_log.exception(
+                    "pipeline", "pipeline %s failed to bind; serving "
+                    "single-stage", self._pipeline_config.name,
+                )
+            with self._lock:
+                self._pipeline_engine = engine
 
     # -- last-known-good pointer (survives restarts) -------------------------
     def _lkg_path(self) -> str:
@@ -778,6 +822,20 @@ class QueryServer:
             lambda: 1.0 if self._coalesce else 0.0,
         )
         _bridges.bridge_event_cache(reg, self._event_cache_stats)
+        # pio_tenant_*: emits only while a tenant registry is installed
+        # (PIO_TENANTS unset keeps /metrics byte-identical); tenant and
+        # variant labels ride under the PIO_METRICS_MAX_SERIES cap like
+        # every other labeled family
+        if self._tenants is not None:
+            _bridges.bridge_tenancy(reg, self._tenants.stats)
+        # pio_pipeline_*: emits only while a composed pipeline is bound
+        _bridges.bridge_pipeline(
+            reg,
+            lambda: (
+                self._pipeline_engine.stats()
+                if self._pipeline_engine is not None else None
+            ),
+        )
         _bridges.bridge_resilience(
             reg,
             lambda: {"breakers": [self._feedback_breaker.stats()]},
@@ -926,19 +984,34 @@ class QueryServer:
 
     # -- query hot loop (parity: CreateServer.scala:484-634) -----------------
     def handle_query(
-        self, data: dict, deadline: Optional[Deadline] = None
+        self,
+        data: dict,
+        deadline: Optional[Deadline] = None,
+        tenant: Optional[str] = None,
+        variant: Optional[str] = None,
     ) -> dict:
         t0 = time.perf_counter()
         with self._lock:
             deployed = self._deployed
+            pipe = self._pipeline_engine
         with _tracing.stage("decode"):
             query = bind_query(self.engine.query_cls, data)
         degraded = False
         cache = self._result_cache
         # one canonical fingerprint serves both layers: the result-cache
-        # key here and the single-flight coalescing key at the batcher
+        # key here and the single-flight coalescing key at the batcher.
+        # Under multi-tenancy the fingerprint is NAMESPACED by tenant +
+        # A/B variant + live engine instance: identical bodies from two
+        # tenants must never share a cache entry or a coalesced leader
+        # slot (cross-tenant answer leakage)
+        namespace = None
+        if tenant is not None:
+            namespace = "\x1f".join(
+                (tenant, variant or "-",
+                 deployed.instance_id if deployed else "")
+            )
         fp = (
-            canonical_fingerprint(data)
+            canonical_fingerprint(data, namespace=namespace)
             if (cache is not None or self._coalesce)
             else None
         )
@@ -967,7 +1040,20 @@ class QueryServer:
             try:
                 if deadline is not None and deadline.expired():
                     raise DeadlineExceeded("deadline expired before predict")
-                if self._batcher is not None:
+                pmeta = None
+                if pipe is not None:
+                    # composed dataflow: retrieval → ranking under
+                    # per-stage shares of this request's deadline; a
+                    # late/failed ranking stage yields the retrieval-only
+                    # answer with degraded:true instead of blowing the SLO
+                    supplemented = deployed.serving.supplement(query)
+                    prediction, pmeta = pipe.run_pipeline(
+                        supplemented, deadline
+                    )
+                    prediction = deployed.serving.serve(
+                        supplemented, [prediction]
+                    )
+                elif self._batcher is not None:
                     supplemented, prediction = self._batcher.submit(
                         query, deadline=deadline,
                         key=fp if self._coalesce else None,
@@ -985,6 +1071,15 @@ class QueryServer:
                     )
                 with _tracing.stage("serialize"):
                     result = _to_jsonable(prediction)
+                if pmeta is not None and pmeta.get("degraded"):
+                    # a stage overran its deadline share: the answer is
+                    # retrieval-only — flagged, counted, never cached
+                    # (it must not outlive the pressure that caused it)
+                    if isinstance(result, dict):
+                        result["degraded"] = True
+                        result["pipelineStage"] = pmeta.get("stage")
+                    degraded = True
+                    self.counters.inc("degraded")
             except DeadlineExceeded:
                 self.counters.inc("deadline_exceeded")
                 raise
@@ -1198,6 +1293,14 @@ class QueryServer:
                 else None
             )
             info["coalesce"] = self._coalesce
+            info["tenancy"] = (
+                self._tenants.stats() if self._tenants is not None else None
+            )
+            info["pipeline"] = (
+                self._pipeline_engine.stats()
+                if self._pipeline_engine is not None
+                else None
+            )
             fp = []
             for algo, model in zip(algorithms, models):
                 get_stats = getattr(algo, "serving_stats", None)
@@ -1327,32 +1430,7 @@ class QueryServer:
             body["status"] = "ready"
             return json_response(200, body)
 
-        @svc.route("POST", r"/queries\.json")
-        def queries(req: Request):
-            with _tracing.stage("decode"):
-                data = req.json()
-            if not isinstance(data, dict):
-                return json_response(400, {"message": "query must be a JSON object"})
-            if self._draining:
-                # draining: in-flight work finishes, new work goes elsewhere
-                return Response(
-                    status=503,
-                    body={"message": "server draining; retry against "
-                          "another instance"},
-                    headers={"Retry-After": f"{self.retry_after_s():g}"},
-                )
-            if self._pod_lockstep():
-                # refusing beats deadlocking: one process of a
-                # process-spanning pod mesh cannot dispatch alone — its
-                # SPMD peers would never join the cross-host collective
-                return Response(
-                    status=503,
-                    body={"message": "pod mesh spans processes: queries "
-                          "must be dispatched in SPMD lockstep on every "
-                          "process, not routed to one — serve through "
-                          "self-contained host-local replicas instead"},
-                    headers={"Retry-After": f"{self.retry_after_s():g}"},
-                )
+        def _serve_admitted(req, data, tenant, variant):
             # admission control: beyond max_inflight, queueing only adds
             # latency to requests that will miss their deadlines anyway —
             # shed with 503 + Retry-After so callers back off
@@ -1380,9 +1458,18 @@ class QueryServer:
                     # request see the budget via current_deadline() even
                     # where no deadline parameter reaches them
                     with deadline_scope(deadline):
-                        return json_response(
-                            200, self.handle_query(data, deadline)
-                        )
+                        # untenanted servers keep the two-arg calling
+                        # convention — handle_query is a documented
+                        # wrap/override point (drain tests, operators)
+                        # and must not grow required kwargs under them
+                        if tenant is None:
+                            result = self.handle_query(data, deadline)
+                        else:
+                            result = self.handle_query(
+                                data, deadline,
+                                tenant=tenant, variant=variant,
+                            )
+                        return json_response(200, result)
                 except DeadlineExceeded as e:
                     return json_response(504, {"message": str(e)})
                 except TypeError as e:
@@ -1390,6 +1477,85 @@ class QueryServer:
             finally:
                 with self._inflight_lock:
                     self._inflight -= 1
+
+        @svc.route("POST", r"/queries\.json")
+        def queries(req: Request):
+            with _tracing.stage("decode"):
+                data = req.json()
+            if not isinstance(data, dict):
+                return json_response(400, {"message": "query must be a JSON object"})
+            if self._draining:
+                # draining: in-flight work finishes, new work goes elsewhere
+                return Response(
+                    status=503,
+                    body={"message": "server draining; retry against "
+                          "another instance"},
+                    headers={"Retry-After": f"{self.retry_after_s():g}"},
+                )
+            if self._pod_lockstep():
+                # refusing beats deadlocking: one process of a
+                # process-spanning pod mesh cannot dispatch alone — its
+                # SPMD peers would never join the cross-host collective
+                return Response(
+                    status=503,
+                    body={"message": "pod mesh spans processes: queries "
+                          "must be dispatched in SPMD lockstep on every "
+                          "process, not routed to one — serve through "
+                          "self-contained host-local replicas instead"},
+                    headers={"Retry-After": f"{self.retry_after_s():g}"},
+                )
+            reg = self._tenants
+            if reg is None:
+                return _serve_admitted(req, data, None, None)
+            # multi-tenant surface: the event-server auth contract on the
+            # query plane — key from ?accessKey=, X-PIO-Access-Key, or the
+            # body's accessKey field (stripped from cache fingerprints)
+            key = extract_access_key(req.params, req.headers, data)
+            if not key:
+                return json_response(401, {"message": "Missing accessKey."})
+            spec = reg.authenticate(key)
+            if spec is None:
+                return json_response(401, {"message": "Invalid accessKey."})
+            tenant = spec.tenant_id
+            act = _faults.check(f"client:tenant:{tenant}")
+            if act is not None:
+                # a chaos-injected bad request FROM this tenant: it feeds
+                # this tenant's breaker only — the isolation contract the
+                # chaos suite asserts on every other tenant's breaker
+                if act.latency_s:
+                    time.sleep(act.latency_s)
+                if act.kind in ("error", "drop", "crash"):
+                    reg.record_result(tenant, None, ok=False, latency_s=0.0)
+                    return json_response(
+                        act.status or 503,
+                        {"message": "injected fault", "injected": True},
+                    )
+            adm = reg.admit(tenant)
+            if not adm.ok:
+                # per-tenant shed: quota exhausted, fair-share inflight
+                # cap, or this tenant's breaker open — 503 with a
+                # quota-aware Retry-After, never touching other tenants
+                return Response(
+                    status=503,
+                    body={"message": f"tenant {tenant} shed", "tenant": tenant,
+                          "reason": adm.reason},
+                    headers={"Retry-After": f"{adm.retry_after_s:g}"},
+                )
+            variant = reg.pick_variant(tenant, data.get("user"))
+            ok = False
+            t0 = time.perf_counter()
+            try:
+                resp = _serve_admitted(req, data, tenant, variant)
+                # 4xx/503 are the contract working, not tenant failures;
+                # only 5xx server errors feed this tenant's breaker
+                ok = resp.status < 500 or resp.status == 503
+                return resp
+            finally:
+                reg.release(tenant)
+                reg.record_result(
+                    tenant, variant, ok=ok,
+                    latency_s=time.perf_counter() - t0,
+                )
 
         @svc.route("GET", r"/reload")
         @svc.route("POST", r"/reload")

@@ -42,22 +42,33 @@ from typing import Any, Callable, Iterable, Optional
 DEFAULT_KEY_FIELDS = ("user", "users", "item", "items")
 
 
-def canonical_fingerprint(data: dict) -> Optional[str]:
+def canonical_fingerprint(
+    data: dict, namespace: Optional[str] = None
+) -> Optional[str]:
     """Stable fingerprint of a raw query body; None when unfingerprintable.
 
     Sorted keys + compact separators make JSON-equal bodies collide
     regardless of field order; ``prId`` is excluded because the feedback
-    tag never changes what the engine predicts.
+    tag never changes what the engine predicts, and ``accessKey`` because
+    auth metadata never changes the answer — tenant identity lives in
+    ``namespace`` instead.  ``namespace`` (tenant id + engine variant
+    under multi-tenancy) prefixes the fingerprint so two tenants with
+    byte-identical query bodies NEVER share a cache entry or a coalesced
+    leader slot: the fingerprint doubles as the batcher coalescing key,
+    so an un-namespaced key would leak one tenant's answer to another.
     """
     if not isinstance(data, dict):
         return None
     try:
-        return json.dumps(
-            {k: v for k, v in data.items() if k != "prId"},
+        body = json.dumps(
+            {k: v for k, v in data.items() if k not in ("prId", "accessKey")},
             sort_keys=True, separators=(",", ":"),
         )
     except (TypeError, ValueError):
         return None
+    if namespace:
+        return f"{namespace}\x1f{body}"
+    return body
 
 
 def entity_ids_from(data: dict, key_fields: Iterable[str]) -> tuple[str, ...]:

@@ -63,6 +63,7 @@ from predictionio_tpu.common.resilience import (
 )
 from predictionio_tpu import obs
 from predictionio_tpu.obs import bridges as _bridges
+from predictionio_tpu.serving import tenancy as _tenancy
 
 logger = logging.getLogger(__name__)
 
@@ -151,6 +152,7 @@ class Router:
         self._draining = False
         self._fleet = None
         self._autoscaler = None
+        self._tenants = None
         self._rolling = False
         self.default_deadline_ms = default_deadline_ms
         # knobs (each read in exactly one place; documented in
@@ -302,7 +304,7 @@ class Router:
         signals the router already maintains for its own decisions."""
         with self._lock:
             admitted = [r for r in self._replicas if r.state == ADMITTED]
-            return {
+            out = {
                 "replicas": len(self._replicas),
                 "admitted": len(admitted),
                 "inflight": sum(r.inflight for r in self._replicas),
@@ -311,6 +313,14 @@ class Router:
                 "counters": self.counters.snapshot(),
                 "rolling": self._rolling,
             }
+            reg = self._tenants
+        if reg is not None:
+            # per-tenant inflight saturation: the autoscaler treats the
+            # hottest tenant's share as one more pressure component
+            pressure = reg.pressure()
+            out["tenantPressure"] = max(pressure.values(), default=0.0)
+            out["tenants"] = pressure
+        return out
 
     def _retry_after_s(self) -> float:
         """Backpressure-aware ``Retry-After``: PIO_ROUTER_RETRY_AFTER_S is
@@ -655,6 +665,54 @@ class Router:
 
     # -- the query route -----------------------------------------------------
     def _serve_query(self, req: Request) -> Response:
+        """Tenant edge gate, then replica routing.  With no registry
+        attached this is a straight delegation — byte-identical to the
+        pre-tenancy router."""
+        reg = self._tenants
+        if reg is None:
+            return self._route_query(req)
+        try:
+            data = json.loads(req.body) if req.body else None
+        except ValueError:
+            data = None
+        key = _tenancy.extract_access_key(
+            req.params, req.headers, data if isinstance(data, dict) else None
+        )
+        if not key:
+            return json_response(401, {"message": "Missing accessKey."})
+        spec = reg.authenticate(key)
+        if spec is None:
+            return json_response(401, {"message": "Invalid accessKey."})
+        tenant = spec.tenant_id
+        adm = reg.admit(tenant)
+        if not adm.ok:
+            self.counters.inc("shed")
+            return Response(
+                status=503,
+                body={"message": f"tenant {tenant} shed", "tenant": tenant,
+                      "reason": adm.reason},
+                headers={"Retry-After": f"{adm.retry_after_s:g}"},
+            )
+        variant = (
+            reg.pick_variant(tenant, data.get("user"))
+            if isinstance(data, dict) else None
+        )
+        ok = False
+        t0 = time.perf_counter()
+        try:
+            resp = self._route_query(req)
+            # 4xx and sheds are the contract working; only 5xx server
+            # errors feed this tenant's breaker (tenant isolation)
+            ok = resp.status < 500 or resp.status == 503
+            return resp
+        finally:
+            reg.release(tenant)
+            reg.record_result(
+                tenant, variant, ok=ok,
+                latency_s=time.perf_counter() - t0,
+            )
+
+    def _route_query(self, req: Request) -> Response:
         if self._draining:
             return Response(
                 status=503,
@@ -888,6 +946,18 @@ class Router:
             self._autoscaler = scaler
         if self.telemetry is not None:
             _bridges.bridge_autoscaler(self.telemetry.registry, scaler.stats)
+
+    def attach_tenants(self, registry) -> None:
+        """Wire a TenantRegistry: the router authenticates and fair-share
+        admits per tenant BEFORE picking a replica, so one tenant
+        saturating its quota sheds here — at the fleet edge — and its
+        traffic never occupies replica slots another tenant needs.
+        Per-tenant sheds/pressure surface on signals() (the autoscaler's
+        input) and as pio_tenant_* families on this router's /metrics."""
+        with self._lock:
+            self._tenants = registry
+        if self.telemetry is not None:
+            _bridges.bridge_tenancy(self.telemetry.registry, registry.stats)
 
     def set_replica_draining(self, url: str, draining: bool) -> None:
         """Roll orchestration: stop routing to a replica BEFORE its

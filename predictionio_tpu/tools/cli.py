@@ -405,6 +405,14 @@ def _deploy_fleet(args) -> int:
         port_allocator=lambda: next(next_ports),
     )
     router.attach_fleet(fleet)
+    # multi-tenant fleet: the router admits per tenant at the edge; the
+    # replica subprocesses inherit PIO_TENANTS and enforce the same
+    # registry behind it (auth is checked on both hops)
+    from predictionio_tpu.serving.tenancy import tenants_from_env
+
+    tenants = tenants_from_env()
+    if tenants is not None:
+        router.attach_tenants(tenants)
     autoscale = (
         getattr(args, "autoscale", False)
         or os.environ.get("PIO_AUTOSCALE", "0") != "0"
@@ -434,6 +442,12 @@ def _deploy_fleet(args) -> int:
 def cmd_deploy(args) -> int:
     from predictionio_tpu.serving.query_server import QueryServer
 
+    # --tenants / --pipeline publish through the env knobs so fleet
+    # replica subprocesses inherit the same registry and pipeline
+    if getattr(args, "tenants", None):
+        os.environ["PIO_TENANTS"] = args.tenants
+    if getattr(args, "pipeline", None):
+        os.environ["PIO_PIPELINE"] = args.pipeline
     if getattr(args, "fleet", 0) and args.fleet > 1:
         return _deploy_fleet(args)
     variant = load_variant(args)
@@ -503,6 +517,81 @@ def cmd_fleet(args) -> int:
         return _die(f"router answered {e.code}: {e.read().decode()}")
     except OSError as e:
         return _die(f"no router at {base}: {e}")
+
+
+def cmd_tenants(args) -> int:
+    """``pio tenants check|list``: validate a tenant registry config
+    offline (check), or print a live server's per-tenant admission /
+    variant stats (list)."""
+    from predictionio_tpu.serving.tenancy import registry_from_config
+
+    if args.tenants_command == "check":
+        source = args.config or os.environ.get("PIO_TENANTS", "")
+        if not source:
+            return _die("no config: pass --config or set PIO_TENANTS")
+        try:
+            if source.strip().startswith(("{", "[")):
+                config = json.loads(source)
+            else:
+                with open(source, "r", encoding="utf-8") as f:
+                    config = json.load(f)
+            reg = registry_from_config(config)
+        except (OSError, ValueError) as e:
+            return _die(f"invalid tenant config: {e}")
+        print(json.dumps(
+            {
+                "tenants": [s.to_dict() for s in reg.specs()],
+                "engineVariants": sorted(reg.engine_variants()),
+            },
+            indent=2,
+        ))
+        print(f"[INFO] Tenant config OK ({len(reg.specs())} tenants).")
+        return 0
+    # list: live server stats
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            info = json.loads(r.read().decode("utf-8"))
+    except OSError as e:
+        return _die(f"no server at {url}: {e}")
+    tenancy = info.get("tenancy")
+    if tenancy is None:
+        print("[INFO] Server has no tenant registry (PIO_TENANTS unset).")
+        return 0
+    print(json.dumps(tenancy, indent=2))
+    return 0
+
+
+def cmd_pipeline(args) -> int:
+    """``pio pipeline seal|show``: publish a pipeline JSON config as a
+    sealed deployable blob, or open + verify + describe a sealed one."""
+    from predictionio_tpu.core.persistence import ModelIntegrityError
+    from predictionio_tpu.serving.pipeline import (
+        PipelineConfig, load_pipeline, save_pipeline,
+    )
+
+    if args.pipeline_command == "seal":
+        try:
+            with open(args.config, "r", encoding="utf-8") as f:
+                config = PipelineConfig.from_dict(json.load(f))
+        except (OSError, ValueError, KeyError) as e:
+            return _die(f"invalid pipeline config: {e}")
+        save_pipeline(config, args.out)
+        print(f"[INFO] Sealed pipeline {config.name!r} "
+              f"({config.fingerprint}) -> {args.out}. "
+              f"Deploy with PIO_PIPELINE={args.out}.")
+        return 0
+    # show
+    try:
+        config = load_pipeline(args.path)
+    except ModelIntegrityError as e:
+        return _die(f"pipeline blob failed integrity check: {e}")
+    except (OSError, ValueError) as e:
+        return _die(f"cannot load pipeline: {e}")
+    print(json.dumps(config.describe(), indent=2))
+    return 0
 
 
 def cmd_undeploy(args) -> int:
@@ -1306,7 +1395,54 @@ def build_parser() -> argparse.ArgumentParser:
         "router's load signals (PIO_AUTOSCALE_* knobs set the bounds "
         "and thresholds); equivalent to PIO_AUTOSCALE=1",
     )
+    sp.add_argument(
+        "--tenants", default=None, metavar="PATH_OR_JSON",
+        help="tenant registry config (JSON file or inline): per-tenant "
+        "access keys, quotas, SLOs, weights, A/B variants; equivalent "
+        "to PIO_TENANTS",
+    )
+    sp.add_argument(
+        "--pipeline", default=None, metavar="PATH_OR_JSON",
+        help="composed retrieval->ranking pipeline: sealed blob from "
+        "`pio pipeline seal` (or inline JSON for dev); equivalent to "
+        "PIO_PIPELINE",
+    )
     sp.set_defaults(func=cmd_deploy)
+
+    sp = sub.add_parser(
+        "tenants", help="validate tenant configs / inspect live "
+        "per-tenant admission and A/B stats"
+    )
+    tenants_sub = sp.add_subparsers(dest="tenants_command", required=True)
+    x = tenants_sub.add_parser(
+        "check", help="validate a tenant registry config offline"
+    )
+    x.add_argument("--config", default=None,
+                   help="JSON file or inline JSON (default: PIO_TENANTS)")
+    x.set_defaults(func=cmd_tenants)
+    x = tenants_sub.add_parser(
+        "list", help="print a live server's per-tenant stats"
+    )
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=8000)
+    x.set_defaults(func=cmd_tenants)
+
+    sp = sub.add_parser(
+        "pipeline", help="seal or inspect a composed retrieval->ranking "
+        "pipeline config"
+    )
+    pipeline_sub = sp.add_subparsers(dest="pipeline_command", required=True)
+    x = pipeline_sub.add_parser(
+        "seal", help="publish pipeline JSON as a sealed deployable blob"
+    )
+    x.add_argument("--config", required=True, help="pipeline JSON file")
+    x.add_argument("--out", required=True, help="sealed blob output path")
+    x.set_defaults(func=cmd_pipeline)
+    x = pipeline_sub.add_parser(
+        "show", help="open + verify + describe a sealed pipeline blob"
+    )
+    x.add_argument("path", help="sealed pipeline blob")
+    x.set_defaults(func=cmd_pipeline)
 
     sp = sub.add_parser(
         "fleet", help="operate a running fleet router (status / roll)"
