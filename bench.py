@@ -1484,6 +1484,266 @@ def _fleet_bench(ctx) -> dict:
     return out
 
 
+def _canary_bench(ctx) -> dict:
+    """Progressive-delivery evidence (ISSUE 20): a deliberately BAD
+    candidate generation (fault-injected latency on exactly that
+    generation's serving path) is canaried onto one replica of a
+    three-replica fleet under client load.  The controller must detect
+    the SLO breach online, auto-roll the canary back to the baseline,
+    and write a durable quarantine receipt.
+
+    The gates are: ``rolled_back`` (the candidate was quarantined, not
+    promoted), ``client_errors == 0`` (the whole experiment is invisible
+    to clients), ``blast_radius`` ≤ the canary fraction plus slack (only
+    the one canaried replica's share of traffic ever saw the bad
+    generation), and ``receipt_blocks_redeploy`` (after the rollback,
+    newest-COMPLETED selection — what every restarted replica runs —
+    resolves to the baseline, and a second canary attempt refuses for
+    want of a candidate).
+    """
+    import shutil
+    import socket
+    import tempfile
+    import threading
+    import urllib.request
+
+    import predictionio_tpu
+    from predictionio_tpu.core import persistence
+    from predictionio_tpu.core.workflow import (
+        get_latest_completed_instance,
+        run_train,
+    )
+    from predictionio_tpu.data import Event
+    from predictionio_tpu.data import store as store_mod
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.data.storage.sqlite import close_db
+    from predictionio_tpu.serving.canary import CanaryController
+    from predictionio_tpu.serving.fleet import FleetSupervisor
+    from predictionio_tpu.serving.router import ADMITTED, Router
+    from predictionio_tpu.templates.recommendation import (
+        RecommendationEngine,
+    )
+
+    slow_ms = float(os.environ.get("BENCH_CANARY_SLOW_MS", 300.0))
+    slo_ms = float(os.environ.get("BENCH_CANARY_SLO_MS", 120.0))
+    tmp = tempfile.mkdtemp(prefix="pio-canary-bench-")
+    src = "CANARYB"
+    storage_env = {
+        f"PIO_STORAGE_SOURCES_{src}_TYPE": "sqlite",
+        f"PIO_STORAGE_SOURCES_{src}_PATH": os.path.join(
+            tmp, "events.sqlite"
+        ),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": src,
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": src,
+    }
+    old_basedir = os.environ.get("PIO_FS_BASEDIR")
+    os.environ["PIO_FS_BASEDIR"] = os.path.join(tmp, "fs")
+    # canary knobs: a short, aggressive window so the bench converges in
+    # seconds; the absolute-p99 SLO mode makes the verdict deterministic
+    knob_env = {
+        "PIO_CANARY_TICK_MS": "100",
+        "PIO_CANARY_MIN_SAMPLES": "30",
+        "PIO_CANARY_WINDOW_S": "15",
+        "PIO_CANARY_P99_SLO_MS": f"{slo_ms:g}",
+        "PIO_CANARY_SHADOW_BUDGET": "16",
+        "PIO_CANARY_SOAK_S": "2",
+    }
+    old_knobs = {k: os.environ.get(k) for k in knob_env}
+    os.environ.update(knob_env)
+    routers: list = []
+    fleets: list = []
+    canary = None
+    out: dict = {}
+    try:
+        storage = Storage(env=storage_env)
+        store_mod.set_storage(storage)
+        app_id = storage.get_meta_data_apps().insert(App(0, "canarybench"))
+        le = storage.get_l_events()
+        le.init(app_id)
+        rng = np.random.default_rng(29)
+        events = []
+        for u in range(20):
+            for i in rng.choice(16, size=6, replace=False):
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties={"rating": float(rng.integers(1, 6))},
+                ))
+        le.batch_insert(events, app_id)
+        engine = RecommendationEngine.apply()
+        ep = engine.params_from_variant({
+            "datasource": {"params": {"appName": "canarybench"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 3}}
+            ],
+        })
+        baseline_id = run_train(engine, ep, "f", storage=storage, ctx=ctx)
+        candidate_id = run_train(engine, ep, "f", storage=storage, ctx=ctx)
+
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(predictionio_tpu.__file__))
+        )
+        child_env = dict(os.environ)
+        child_env.pop("PIO_FAULT_SPEC", None)
+        child_env.update(storage_env)
+        child_env["JAX_PLATFORMS"] = "cpu"
+        child_env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root] + ([child_env["PYTHONPATH"]]
+                           if child_env.get("PYTHONPATH") else [])
+        )
+        # every child: cold-start pinned to the BASELINE (the candidate
+        # is newer, so unpinned children would boot straight onto the
+        # unverified generation) and carrying the generation-targeted
+        # fault — the candidate generation is slow IN WHICHEVER PROCESS
+        # serves it, exactly like a model with a real latency regression
+        child_env["PIO_PIN_INSTANCE"] = baseline_id
+        child_env["PIO_FAULT_SPEC"] = (
+            f"site=server:generation:{candidate_id},kind=latency,"
+            f"latency_ms={slow_ms:g},p=0.9"
+        )
+
+        def spawn(port):
+            cenv = dict(child_env)
+            cenv["FLEET_CHILD_PORT"] = str(port)
+            return subprocess.Popen(
+                [sys.executable, "-c", _FLEET_CHILD], env=cenv,
+            )
+
+        socks = [socket.socket() for _ in range(3)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+        fleet = FleetSupervisor(spawn, ports)
+        fleets = [fleet]
+        fleet.start()
+        router = Router(fleet.urls(), telemetry=False)
+        router.health_interval_ms = 100.0
+        # the canary controller is the intended responder to a slow
+        # generation — don't let latency-outlier ejection race it
+        router.outlier_ratio = 1e9
+        routers.append(router)
+        fleet.router = router
+        router.attach_fleet(fleet)
+        canary = CanaryController(
+            router, fleet=fleet, storage=storage
+        )
+        router.attach_canary(canary)
+        rport = router.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{rport}"
+
+        t_end = time.time() + 180.0
+        while time.time() < t_end:
+            reps = router.stats()["replicas"]
+            if reps and all(
+                x["state"] == ADMITTED and x["instanceId"] == baseline_id
+                for x in reps
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("canary bench replicas never became ready")
+
+        stop_evt = threading.Event()
+        lock = threading.Lock()
+        tally = {"ok": 0, "errors": 0}
+
+        def pound(idx):
+            i = 0
+            while not stop_evt.is_set():
+                body = json.dumps(
+                    {"user": f"u{(i * 7 + idx) % 20}", "num": 3}
+                ).encode()
+                req = urllib.request.Request(
+                    base + "/queries.json", data=body, method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        resp.read()
+                        ok = resp.status == 200
+                except Exception:
+                    ok = False
+                with lock:
+                    tally["ok" if ok else "errors"] += 1
+                i += 1
+
+        workers = [
+            threading.Thread(target=pound, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for w in workers:
+            w.start()
+        t0 = time.time()
+        canary.start_canary()
+        while canary.active() and time.time() - t0 < 120.0:
+            time.sleep(0.2)
+        wall = time.time() - t0
+        stop_evt.set()
+        for w in workers:
+            w.join(30.0)
+
+        stats = canary.stats()
+        outcome = stats.get("lastOutcome") or {}
+        gens = router.generation_stats()
+        cand = gens.get(candidate_id) or {}
+        attributed = sum(
+            g.get("requests", 0) for g in gens.values()
+        )
+        blast = (
+            cand.get("requests", 0) / attributed if attributed else None
+        )
+        blocks = get_latest_completed_instance(storage).id == baseline_id
+        try:
+            canary.start_canary()
+            second_refused = False
+            canary.request_abort()
+        except ValueError:
+            second_refused = True
+        out = {
+            "baseline": baseline_id,
+            "candidate": candidate_id,
+            "wall_sec": round(wall, 1),
+            "rolled_back": outcome.get("outcome") == "quarantined"
+            and outcome.get("candidate") == candidate_id,
+            "rollback_reason": outcome.get("reason"),
+            "client_ok": tally["ok"],
+            "client_errors": tally["errors"],
+            "blast_radius": round(blast, 4) if blast is not None else None,
+            "candidate_requests": cand.get("requests", 0),
+            "candidate_p99_ms": cand.get("p99Ms"),
+            "shadow_pairs": (stats.get("shadow") or {}).get("pairs", 0),
+            "quarantined": stats.get("quarantined"),
+            "receipt_on_disk": persistence.is_quarantined(candidate_id),
+            "selection_resolves_baseline": blocks,
+            "second_canary_refused": second_refused,
+            "receipt_blocks_redeploy": blocks and second_refused,
+        }
+    finally:
+        if canary is not None:
+            canary.stop()
+        for r in routers:
+            r.stop()
+        for f in fleets:
+            f.stop()
+        for k, v in old_knobs.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        store_mod.set_storage(None)
+        close_db(os.path.join(tmp, "events.sqlite"))
+        if old_basedir is None:
+            os.environ.pop("PIO_FS_BASEDIR", None)
+        else:
+            os.environ["PIO_FS_BASEDIR"] = old_basedir
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _elastic_bench(ctx) -> dict:
     """Elastic fleet evidence (ISSUE 11): a flash-crowd scenario (10x
     step) replayed against an autoscaled two-replica fleet, with a
@@ -2904,6 +3164,14 @@ def main() -> None:
             print(f"WARNING: tenant bench failed: {e}", file=sys.stderr)
             tenant = {"error": str(e)}
         print(f"INFO: tenant: {tenant}", file=sys.stderr)
+    canary = None
+    if os.environ.get("BENCH_CANARY", "1") != "0":
+        try:
+            canary = _canary_bench(ctx)
+        except Exception as e:  # the canary gate must never kill the artifact
+            print(f"WARNING: canary bench failed: {e}", file=sys.stderr)
+            canary = {"error": str(e)}
+        print(f"INFO: canary: {canary}", file=sys.stderr)
     record = {
         "metric": "als_train_events_per_sec_per_chip",
         "value": round(value, 1),
@@ -2960,6 +3228,8 @@ def main() -> None:
         record["retrieval"] = retrieval
     if tenant is not None:
         record["tenant"] = tenant
+    if canary is not None:
+        record["canary"] = canary
     if "zipf" in results and primary_dist != "zipf":
         record["zipf"] = {
             "value": round(results["zipf"], 1),

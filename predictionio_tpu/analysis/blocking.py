@@ -82,11 +82,14 @@ _EXEMPT_FUNCS = {"__init__", "_compile", "stats", "stop", "close",
 # (_health_loop/_monitor_loop/_control_loop: the router's probe pacer,
 # the fleet supervisor's child watcher, and the autoscaler's decision
 # pacer; _delta_loop/_catchup_loop: the event server's delta flush
-# worker and the replica's delta catch-up worker — all must pace on
-# Event.wait and delegate real I/O to non-loop helpers)
+# worker and the replica's delta catch-up worker;
+# _verify_loop/_soak_loop: the canary controller's verification window
+# and post-promotion soak watchdog — all must pace on Event.wait and
+# delegate real I/O to non-loop helpers)
 _HOT_LOOP_NAMES = {"_loop", "_run", "_flush", "_drain",
                    "_health_loop", "_monitor_loop", "_control_loop",
-                   "_delta_loop", "_catchup_loop"}
+                   "_delta_loop", "_catchup_loop",
+                   "_verify_loop", "_soak_loop"}
 
 # callee name → why it blocks
 _BLOCKING_ATTRS = {

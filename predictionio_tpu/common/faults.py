@@ -54,6 +54,23 @@ SEEDED schedule, at named fault SITES compiled into the service planes:
   a slow or failing ranking stage must degrade the response to the
   retrieval-only answer (``degraded:true``) inside the stage's share
   of the request deadline, never blow the end-to-end SLO.
+* ``server:generation:<instance_id>`` — consulted by the query server's
+  ``/queries.json`` route against the currently DEPLOYED engine
+  instance id (latency / error): makes one specific model generation
+  misbehave under real traffic, which is how the canary suite plants a
+  "bad candidate" that loads fine but breaches its SLO online.
+* ``client:canary:shadow`` — consulted by the canary controller before
+  each shadow-mirror replay (``serving/canary.py``): a failing shadow
+  hop must burn shadow budget, never count against the candidate's
+  verdict or touch a client-visible response.
+* ``crash:canary:mid_promote`` — compiled between the canary
+  controller's per-replica promotion reloads: the controller dies with
+  the fleet HALF-promoted; resume() must finish the promotion
+  idempotently from the journaled replica list.
+* ``crash:canary:before_receipt`` — compiled after the rollback reloads
+  but before the quarantine receipt lands: the journaled ROLLING_BACK
+  intent (with its quarantine verdict) must still produce the receipt
+  on resume, so the bad generation stays blocked across the crash.
 
 Nothing fires unless a plan is installed — the shim is one ``is None``
 check on the hot path.  Installation is programmatic (:func:`install`,

@@ -1066,6 +1066,125 @@ def bridge_autoscaler(
     registry.register_collector(collect)
 
 
+CANARY_STATE_VALUES = {
+    "idle": 0.0, "verifying": 1.0, "promoting": 2.0, "soaking": 3.0,
+    "rolling_back": 4.0,
+}
+
+
+def bridge_canary(
+    registry: MetricsRegistry, stats_fn: Callable[[], Optional[dict]]
+) -> None:
+    """CanaryController ``stats()`` → pio_canary_* series: the rollout
+    state machine, per-generation verdict inputs, shadow-mirror volume,
+    and the quarantine ledger depth."""
+
+    def collect():
+        s = stats_fn()
+        if not s:
+            return []
+        counters = s.get("counters") or {}
+        shadow = s.get("shadow") or {}
+        cand = s.get("candidateStats") or {}
+        base = s.get("baselineStats") or {}
+        state = str(s.get("state") or "idle")
+        fams = [
+            _fam(
+                "pio_canary_state", "gauge",
+                "Controller state: 0 idle, 1 verifying, 2 promoting, "
+                "3 soaking, 4 rolling_back.",
+                [("", (), CANARY_STATE_VALUES.get(state, 0.0))],
+            ),
+            _fam(
+                "pio_canary_epoch", "gauge",
+                "Fencing epoch of the journal owner; bumps on every "
+                "canary start and every controller resume.",
+                [("", (), _num(s.get("epoch")))],
+            ),
+            _fam(
+                "pio_canary_info", "gauge",
+                "Constant-1 info series; the labels carry the current "
+                "state and candidate/baseline generation ids.",
+                [(
+                    "", (
+                        ("state", state),
+                        ("candidate", str(s.get("candidate") or "")),
+                        ("baseline", str(s.get("baseline") or "")),
+                    ), 1.0,
+                )],
+            ),
+            _fam(
+                "pio_canary_shadow_queries_total", "counter",
+                "Shadow-mirrored query pairs replayed against candidate "
+                "+ baseline (answers discarded), by outcome.",
+                [
+                    ("", (("outcome", "ok"),), _num(counters.get("shadow_ok"))),
+                    ("", (("outcome", "error"),),
+                     _num(counters.get("shadow_errors"))),
+                ],
+            ),
+            _fam(
+                "pio_canary_shadow_overlap", "gauge",
+                "Mean top-k prediction overlap between candidate and "
+                "baseline over this window's shadow pairs.",
+                [("", (), _num(shadow.get("meanOverlap"), 0.0))],
+            ),
+            _fam(
+                "pio_canary_candidate_error_rate", "gauge",
+                "Attributed online error rate of the candidate "
+                "generation (real traffic, router-attributed).",
+                [("", (), _num(cand.get("errorRate")))],
+            ),
+            _fam(
+                "pio_canary_candidate_p99_ms", "gauge",
+                "Attributed online p99 latency of the candidate "
+                "generation, milliseconds.",
+                [("", (), _num(cand.get("p99Ms")))],
+            ),
+            _fam(
+                "pio_canary_baseline_p99_ms", "gauge",
+                "Attributed online p99 latency of the baseline "
+                "generation, milliseconds (the ratio-SLO denominator).",
+                [("", (), _num(base.get("p99Ms")))],
+            ),
+            _fam(
+                "pio_canary_verifications_total", "counter",
+                "Verification windows concluded, by verdict.",
+                [
+                    ("", (("outcome", "pass"),),
+                     _num(counters.get("verifications_pass"))),
+                    ("", (("outcome", "fail"),),
+                     _num(counters.get("verifications_fail"))),
+                ],
+            ),
+            _fam(
+                "pio_canary_rollbacks_total", "counter",
+                "Automatic rollbacks executed, by phase (verify = canary "
+                "replica only, soak = runtime fleet-wide to LKG).",
+                [
+                    ("", (("phase", "verify"),),
+                     _num(counters.get("rollbacks_verify"))),
+                    ("", (("phase", "soak"),),
+                     _num(counters.get("rollbacks_soak"))),
+                ],
+            ),
+            _fam(
+                "pio_canary_promotions_total", "counter",
+                "Canaries promoted to the full fleet.",
+                [("", (), _num(counters.get("promotions")))],
+            ),
+            _fam(
+                "pio_canary_quarantined_generations", "gauge",
+                "Engine instance ids currently blocked by a durable "
+                "quarantine receipt.",
+                [("", (), float(len(s.get("quarantined") or [])))],
+            ),
+        ]
+        return fams
+
+    registry.register_collector(collect)
+
+
 # -- data plane: event-server Stats + ingest buffer --------------------------
 
 def bridge_event_stats(registry: MetricsRegistry, stats) -> None:

@@ -109,6 +109,14 @@ class FleetSupervisor:
         self._stop_evt = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
         self._transitions = {"up": 0, "down": 0}
+        # canary mutual exclusion (guarded by _lock): while a canary is
+        # in flight, every spawn is pinned to the verified BASELINE
+        # generation (autoscaler scale-ups and crash restarts must never
+        # come up on the unverified candidate), and the canary replica's
+        # url is protected from scale-down (removing the one replica
+        # under verification would silently end the experiment)
+        self._spawn_pin: Optional[str] = None
+        self._protected: set[str] = set()
         self.restart_backoff_s = _env_num(
             "PIO_FLEET_RESTART_BACKOFF_S", 0.5, float
         )
@@ -132,7 +140,17 @@ class FleetSupervisor:
         self._monitor_thread.start()
 
     def _spawn_locked(self, rp: ReplicaProc) -> None:
-        rp.proc = self.spawn_fn(rp.port)
+        # the pin rides the process environment: children inherit it at
+        # spawn (cli re-exec) and the query server honors it at cold
+        # start only — an explicit /reload?instanceId= still overrides
+        pin = self._spawn_pin
+        if pin:
+            os.environ["PIO_PIN_INSTANCE"] = pin
+        try:
+            rp.proc = self.spawn_fn(rp.port)
+        finally:
+            if pin:
+                os.environ.pop("PIO_PIN_INSTANCE", None)
         rp.started_at = time.monotonic()
         rp.expected_down = False
         self._transitions["up"] += 1
@@ -202,6 +220,26 @@ class FleetSupervisor:
                 "fault shim preempted replica pid %d (kill -9)", pid
             )
 
+    # -- canary mutual exclusion ---------------------------------------------
+    def set_spawn_pin(self, instance_id: Optional[str]) -> None:
+        """While set, children spawned by this supervisor (scale-ups,
+        crash restarts) cold-start pinned to ``instance_id`` — the canary
+        controller pins the BASELINE for the verification window so a
+        mid-canary scale-up can never come up on the unverified
+        candidate.  ``None`` clears the pin."""
+        with self._lock:
+            self._spawn_pin = instance_id or None
+
+    def protect_replica(self, url: str, protected: bool) -> None:
+        """Exempt one replica from scale-down (the canary replica during
+        its verification window); clearing re-enables removal."""
+        url = url.rstrip("/")
+        with self._lock:
+            if protected:
+                self._protected.add(url)
+            else:
+                self._protected.discard(url)
+
     # -- elastic scaling -----------------------------------------------------
     def _alloc_port(self) -> int:
         if self.port_allocator is not None:
@@ -244,6 +282,7 @@ class FleetSupervisor:
                 cands = [
                     rp for rp in self._procs
                     if not rp.expected_down and not rp.removing
+                    and rp.url not in self._protected
                 ]
                 if url is not None:
                     cands = [rp for rp in cands if rp.url == url]
@@ -283,7 +322,13 @@ class FleetSupervisor:
     def roll(self) -> dict:
         """Drain → restart → verify each replica in sequence.  Returns a
         per-replica report; raises nothing (a failed replica is reported
-        and the roll continues — partial fleets beat dead rolls)."""
+        and the roll continues — partial fleets beat dead rolls).
+
+        Target resolution happens in each restarted CHILD: it cold-starts
+        on the newest COMPLETED generation via
+        ``workflow.get_latest_completed_instance``, which skips
+        quarantined instance ids — so a roll can never re-deploy a
+        generation a canary rolled back."""
         with self._lock:
             procs = [rp for rp in self._procs if not rp.removing]
         report = []
@@ -425,6 +470,8 @@ class FleetSupervisor:
                     for rp in self._procs
                 ],
                 "transitions": dict(self._transitions),
+                "spawnPin": self._spawn_pin,
+                "protected": sorted(self._protected),
             }
 
     def stats(self) -> dict:

@@ -48,6 +48,7 @@ from predictionio_tpu.common.resilience import (
 from predictionio_tpu import obs
 from predictionio_tpu.core import delta as _delta
 from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core import persistence
 from predictionio_tpu.core.persistence import open_model_blob
 from predictionio_tpu.core.workflow import (
     get_latest_completed_instance,
@@ -303,8 +304,18 @@ class QueryServer:
             self._register_metrics()
 
     # -- model lifecycle -----------------------------------------------------
-    def reload(self) -> str:
+    def reload(self, instance_id: Optional[str] = None,
+               force: bool = False) -> str:
         """(Re)load the latest COMPLETED instance; atomic swap.
+
+        ``instance_id`` pins the load to ONE specific generation — the
+        canary controller's hot-swap primitive (roll the canary replica to
+        the candidate, roll it back to the baseline) — and refuses a
+        QUARANTINED id unless ``force`` is set (operator override).  With
+        no ``instance_id`` the newest non-quarantined COMPLETED instance
+        deploys; a cold start additionally honors ``PIO_PIN_INSTANCE``
+        (injected by the fleet while a canary is in flight) so autoscaler
+        scale-ups spawn on the verified baseline, never the candidate.
 
         Graceful degradation: when a RELOAD fails (storage down, corrupt
         blob, bad hot-swap) and a previous generation is live, the server
@@ -315,12 +326,34 @@ class QueryServer:
         generation); only a cold start with nothing deployable left fails
         loudly.
         """
+        if instance_id is None and self._deployed is None:
+            pin = os.environ.get("PIO_PIN_INSTANCE", "").strip()
+            if pin:
+                instance_id = pin
         instance = None
         try:
-            instance = get_latest_completed_instance(
-                self.storage, self.engine_id, self.engine_version,
-                self.engine_variant,
-            )
+            if instance_id is not None:
+                if not force and persistence.is_quarantined(
+                    instance_id, self.engine_id, self.engine_version,
+                    self.engine_variant,
+                ):
+                    raise RuntimeError(
+                        f"engine instance {instance_id} is quarantined "
+                        "(failed online verification); pass force to "
+                        "override"
+                    )
+                instance = self.storage.get_meta_data_engine_instances().get(
+                    instance_id
+                )
+                if instance is None:
+                    raise RuntimeError(
+                        f"no engine instance {instance_id}"
+                    )
+            else:
+                instance = get_latest_completed_instance(
+                    self.storage, self.engine_id, self.engine_version,
+                    self.engine_variant,
+                )
             _, algorithms, serving, models = prepare_deploy(
                 self.engine, instance, storage=self.storage, ctx=self.ctx
             )
@@ -453,13 +486,22 @@ class QueryServer:
             )
         except Exception:
             return None
+        # quarantined generations failed ONLINE verification (canary
+        # rollback) — the LKG pointer and the newest-first walk both skip
+        # them, or a restart would re-deploy the exact generation the
+        # canary just rolled back
+        quarantined = persistence.quarantined_instance_ids(
+            self.engine_id, self.engine_version, self.engine_variant
+        )
         by_id = {i.id: i for i in completed}
         order: list[str] = []
         lkg_id = self._read_last_known_good()
-        if lkg_id and lkg_id != failed_id and lkg_id in by_id:
+        if (lkg_id and lkg_id != failed_id and lkg_id in by_id
+                and lkg_id not in quarantined):
             order.append(lkg_id)
         for inst in completed:
-            if inst.id != failed_id and inst.id not in order:
+            if (inst.id != failed_id and inst.id not in order
+                    and inst.id not in quarantined):
                 order.append(inst.id)
         for iid in order:
             try:
@@ -1333,7 +1375,8 @@ class QueryServer:
             # reported but does NOT fail readiness: the last good
             # generation is still serving.
             with self._lock:
-                deployed = self._deployed is not None
+                dep = self._deployed
+                deployed = dep is not None
                 generation = self._serving_gen
                 warm = self._fastpath_warm
             with self._inflight_lock:
@@ -1349,6 +1392,11 @@ class QueryServer:
                 # on *warm*, not merely *loaded*
                 "generation": generation,
                 "fastpathWarm": warm,
+                # the durable identity of the live generation: the local
+                # `generation` counter differs per process, so the canary
+                # controller attributes per-generation metrics (and targets
+                # hot-swaps) by engine instance id
+                "engineInstanceId": dep.instance_id if dep else None,
             }
             # sharded placement: surface backend + plan fingerprint so a
             # rebalance is visible as a generation identity change to
@@ -1504,6 +1552,26 @@ class QueryServer:
                           "self-contained host-local replicas instead"},
                     headers={"Retry-After": f"{self.retry_after_s():g}"},
                 )
+            if _faults.active() is not None:
+                # generation-keyed chaos: a rule on server:generation:<id>
+                # degrades ONLY the replica serving that engine instance —
+                # how the canary bench injects a bad candidate generation
+                # without touching its baseline siblings in the same image
+                with self._lock:
+                    live = self._deployed
+                if live is not None:
+                    act = _faults.check(
+                        f"server:generation:{live.instance_id}"
+                    )
+                    if act is not None:
+                        if act.latency_s:
+                            time.sleep(act.latency_s)
+                        if act.kind in ("error", "drop", "crash"):
+                            return json_response(
+                                act.status or 500,
+                                {"message": "injected generation fault",
+                                 "injected": True},
+                            )
             reg = self._tenants
             if reg is None:
                 return _serve_admitted(req, data, None, None)
@@ -1560,7 +1628,17 @@ class QueryServer:
         @svc.route("GET", r"/reload")
         @svc.route("POST", r"/reload")
         def reload_route(req: Request):
-            iid = self.reload()
+            # ?instanceId= pins the swap to one generation (the canary
+            # controller's promote/rollback hop); quarantined ids refuse
+            # with 409 unless ?force=1 (operator override)
+            target = (req.params.get("instanceId") or "").strip() or None
+            force = (req.params.get("force") or "") in ("1", "true", "yes")
+            try:
+                iid = self.reload(instance_id=target, force=force)
+            except RuntimeError as e:
+                if "quarantined" in str(e):
+                    return json_response(409, {"message": str(e)})
+                raise
             return json_response(200, {"message": "Reloaded", "engineInstanceId": iid})
 
         @svc.route("POST", r"/delta")

@@ -97,6 +97,10 @@ class ReplicaState:
         self.ewma_ms: Optional[float] = None
         self.samples = 0
         self.generation: Optional[int] = None
+        # durable generation identity (engine instance id) from /readyz:
+        # the `generation` counter above is a per-process int — canary
+        # attribution and hot-swap targeting key on THIS instead
+        self.instance_id: Optional[str] = None
         self.delta_epoch: Optional[int] = None
         # pod-scale serving: the host group this replica's serving mesh
         # belongs to, as advertised on /readyz (None = not pod-sharded)
@@ -153,7 +157,19 @@ class Router:
         self._fleet = None
         self._autoscaler = None
         self._tenants = None
+        self._canary = None
         self._rolling = False
+        # per-generation online attribution (canary verification input):
+        # engine instance id → requests/errors/latency window, recorded on
+        # every attempt outcome in _attempt_chain.  Bounded: only the most
+        # recently touched generations are tracked (guarded by _lock).
+        self._gen_stats: dict[str, dict] = {}
+        # shadow-mirror capture: when a canary is verifying, recent REAL
+        # query bodies are kept here (bounded, newest-wins) for the
+        # controller to replay against candidate+baseline — answers
+        # discarded, budget-capped at the controller (guarded by _lock)
+        self._shadow_capture = False
+        self._shadow_buf: deque[bytes] = deque(maxlen=64)
         self.default_deadline_ms = default_deadline_ms
         # knobs (each read in exactly one place; documented in
         # docs/operations.md — the knobs analyzer diffs the defaults)
@@ -341,6 +357,101 @@ class Router:
             max(1, self.replica_max_inflight) * len(admitted)
         )
         return round(min(base * max(1.0, load), 30.0), 2)
+
+    # -- per-generation attribution (canary verification input) --------------
+    _GEN_TRACK_MAX = 8
+    _GEN_LAT_WINDOW = 512
+
+    def _note_gen_outcome(
+        self, rep: ReplicaState, ok: bool,
+        latency_ms: Optional[float] = None,
+    ) -> None:
+        """Attribute one attempt outcome to the engine instance the
+        replica was serving.  Keyed by durable instance id (never the
+        per-process generation counter); bounded to the most recently
+        touched generations so a long-lived router can't grow this
+        without bound."""
+        iid = rep.instance_id
+        if iid is None:
+            return
+        with self._lock:
+            st = self._gen_stats.get(iid)
+            if st is None:
+                if len(self._gen_stats) >= self._GEN_TRACK_MAX:
+                    oldest = min(
+                        self._gen_stats.items(),
+                        key=lambda kv: kv[1]["touched"],
+                    )[0]
+                    del self._gen_stats[oldest]
+                st = {
+                    "requests": 0, "errors": 0,
+                    "lat": deque(maxlen=self._GEN_LAT_WINDOW),
+                    "touched": 0.0,
+                }
+                self._gen_stats[iid] = st
+            st["requests"] += 1
+            if not ok:
+                st["errors"] += 1
+            if latency_ms is not None:
+                st["lat"].append(latency_ms)
+            st["touched"] = time.monotonic()
+
+    def generation_stats(self) -> dict:
+        """Per-generation online metrics: requests, server errors, error
+        rate and p99 over the rolling latency window — the canary
+        controller's verification input."""
+        with self._lock:
+            snap = {
+                iid: (st["requests"], st["errors"], sorted(st["lat"]))
+                for iid, st in self._gen_stats.items()
+            }
+        out = {}
+        for iid, (requests, errors, lat) in snap.items():
+            p99 = (
+                lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+                if lat else None
+            )
+            out[iid] = {
+                "requests": requests,
+                "errors": errors,
+                "errorRate": (errors / requests) if requests else 0.0,
+                "p99Ms": p99,
+                "latencySamples": len(lat),
+            }
+        return out
+
+    # -- shadow-mirror capture (canary quality signal) ------------------------
+    def set_shadow_capture(self, on: bool) -> None:
+        """The canary controller turns capture on for the verification
+        window only; turning it off drops any unclaimed bodies."""
+        with self._lock:
+            self._shadow_capture = bool(on)
+            if not on:
+                self._shadow_buf.clear()
+
+    def take_shadow_samples(self, n: int) -> list[bytes]:
+        """Up to ``n`` captured real query bodies, oldest first; each is
+        handed out exactly once (the controller replays it against
+        candidate + baseline and discards both answers)."""
+        out: list[bytes] = []
+        with self._lock:
+            while self._shadow_buf and len(out) < n:
+                out.append(self._shadow_buf.popleft())
+        return out
+
+    def replica_view(self) -> list[dict]:
+        """Thin per-replica snapshot for the canary controller: which url
+        serves which engine instance, and whether it takes traffic."""
+        with self._lock:
+            return [
+                {
+                    "url": r.url,
+                    "state": r.state,
+                    "instanceId": r.instance_id,
+                    "warm": r.warm,
+                }
+                for r in self._replicas
+            ]
 
     # -- latency window / hedge delay ----------------------------------------
     def _record_latency(self, rep: ReplicaState, ms: float) -> None:
@@ -590,6 +701,10 @@ class Router:
                 outcome = self._forward(current, body, deadline, trace_id)
             except OSError as e:
                 current.breaker.record_failure()
+                # transport failure attributes against the generation the
+                # replica was serving — a candidate that wedges its
+                # process must show up in the canary's error rate
+                self._note_gen_outcome(current, ok=False)
                 with self._lock:
                     current.last_error = f"{type(e).__name__}: {e}"
             finally:
@@ -600,19 +715,23 @@ class Router:
                 if status < 500:
                     current.breaker.record_success()
                     if status < 400:
-                        self._record_latency(
-                            current, (time.perf_counter() - t0) * 1e3
-                        )
+                        ms = (time.perf_counter() - t0) * 1e3
+                        self._record_latency(current, ms)
+                        self._note_gen_outcome(current, ok=True,
+                                               latency_ms=ms)
                         self._complete(slot, outcome, hedged)
                         return
                     if status != 503:
                         # 4xx is the CLIENT's bug: pass through, no retry
+                        # (and no generation attribution — the generation
+                        # did nothing wrong)
                         self._complete(slot, outcome, hedged)
                         return
                     # 503 = replica shedding/draining: alive, just not for
                     # us — try another replica
                 else:
                     current.breaker.record_failure()
+                    self._note_gen_outcome(current, ok=False)
                 last = outcome
             # retry path.  A transport failure (kill -9, refused connect)
             # retries FREE — the attempt consumed nothing downstream and
@@ -728,6 +847,12 @@ class Router:
                 504, {"message": "deadline expired before routing"}
             )
         trace_id = getattr(req.trace, "request_id", None)
+        if self._shadow_capture and req.body:
+            # canary verification window: keep a bounded copy of real
+            # traffic for the controller's shadow mirror (newest-wins)
+            with self._lock:
+                if self._shadow_capture:
+                    self._shadow_buf.append(req.body)
         self.budget.on_attempt()
         group = self._owner_group(req.body)
         slot = _Slot()
@@ -850,6 +975,9 @@ class Router:
             gen = info.get("generation")
             if isinstance(gen, int):
                 rep.generation = gen
+            iid = info.get("engineInstanceId")
+            if isinstance(iid, str) and iid:
+                rep.instance_id = iid
             de = info.get("deltaEpoch")
             if isinstance(de, int):
                 rep.delta_epoch = de
@@ -959,6 +1087,15 @@ class Router:
         if self.telemetry is not None:
             _bridges.bridge_tenancy(self.telemetry.registry, registry.stats)
 
+    def attach_canary(self, controller) -> None:
+        """Wire a CanaryController: `/canary/*` goes live, its state
+        surfaces on stats()/signals(), and ``pio_canary_*`` families
+        register on this router's /metrics."""
+        with self._lock:
+            self._canary = controller
+        if self.telemetry is not None and hasattr(controller, "stats"):
+            _bridges.bridge_canary(self.telemetry.registry, controller.stats)
+
     def set_replica_draining(self, url: str, draining: bool) -> None:
         """Roll orchestration: stop routing to a replica BEFORE its
         process drains, re-open it for probing afterwards."""
@@ -991,6 +1128,7 @@ class Router:
                     ),
                     "ewmaMs": r.ewma_ms,
                     "generation": r.generation,
+                    "instanceId": r.instance_id,
                     "deltaEpoch": r.delta_epoch,
                     "podGroup": r.pod_group,
                     "warm": r.warm,
@@ -1003,7 +1141,10 @@ class Router:
             rolling = self._rolling
             pod_groups = self._pod_group_count_locked()
             pod_routed = {str(g): n for g, n in self._pod_routed.items()}
+            canary = self._canary
         return {
+            "generations": self.generation_stats(),
+            "canary": canary.stats() if canary is not None else None,
             "status": "alive",
             "replicas": replicas,
             "pod": {
@@ -1221,6 +1362,100 @@ class Router:
             ).start()
             return json_response(202, {"message": "roll started"})
 
+        @svc.route("GET", r"/canary")
+        def canary_status(req: Request):
+            with self._lock:
+                canary = self._canary
+            if canary is None:
+                return json_response(
+                    404, {"message": "no canary controller attached"}
+                )
+            return json_response(200, canary.stats())
+
+        @svc.route("POST", r"/canary/start")
+        def canary_start(req: Request):
+            with self._lock:
+                canary = self._canary
+            if canary is None:
+                return json_response(
+                    404, {"message": "no canary controller attached"}
+                )
+            try:
+                data = json.loads(req.body) if req.body else {}
+            except ValueError:
+                data = {}
+            try:
+                started = canary.start_canary(
+                    instance_id=(data or {}).get("instanceId"),
+                    force=bool((data or {}).get("force")),
+                )
+            except ValueError as e:
+                return json_response(409, {"message": str(e)})
+            if not started:
+                return json_response(
+                    409, {"message": "a canary is already in flight"}
+                )
+            return json_response(202, canary.stats())
+
+        @svc.route("POST", r"/canary/promote")
+        def canary_promote(req: Request):
+            with self._lock:
+                canary = self._canary
+            if canary is None:
+                return json_response(
+                    404, {"message": "no canary controller attached"}
+                )
+            if not canary.request_promote():
+                return json_response(
+                    409, {"message": "no canary verifying"}
+                )
+            return json_response(202, canary.stats())
+
+        @svc.route("POST", r"/canary/abort")
+        def canary_abort(req: Request):
+            with self._lock:
+                canary = self._canary
+            if canary is None:
+                return json_response(
+                    404, {"message": "no canary controller attached"}
+                )
+            if not canary.request_abort():
+                return json_response(409, {"message": "no canary active"})
+            return json_response(202, canary.stats())
+
+        @svc.route("GET", r"/canary/quarantine")
+        def canary_quarantine(req: Request):
+            with self._lock:
+                canary = self._canary
+            if canary is None:
+                return json_response(
+                    404, {"message": "no canary controller attached"}
+                )
+            return json_response(200, {"receipts": canary.quarantine()})
+
+        @svc.route("POST", r"/canary/quarantine/release")
+        def canary_release(req: Request):
+            with self._lock:
+                canary = self._canary
+            if canary is None:
+                return json_response(
+                    404, {"message": "no canary controller attached"}
+                )
+            try:
+                data = json.loads(req.body) if req.body else {}
+            except ValueError:
+                data = {}
+            iid = (data or {}).get("instanceId")
+            if not iid:
+                return json_response(
+                    400, {"message": "instanceId required"}
+                )
+            released = canary.release_quarantine(iid)
+            return json_response(
+                200 if released else 404,
+                {"released": released, "instanceId": iid},
+            )
+
         @svc.route("POST", r"/stop")
         def stop_route(req: Request):
             def _stop():
@@ -1256,7 +1491,10 @@ class Router:
         with self._lock:
             self._draining = True
             fleet = self._fleet
+            canary = self._canary
         self._stop_evt.set()
+        if canary is not None:
+            canary.stop()
         if fleet is not None:
             fleet.stop()
         self.service.stop()

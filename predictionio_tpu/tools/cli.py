@@ -421,9 +421,30 @@ def _deploy_fleet(args) -> int:
     if autoscale:
         scaler = Autoscaler(router, fleet)
         router.attach_autoscaler(scaler)
+    canary = None
+    if (
+        getattr(args, "canary", False)
+        or os.environ.get("PIO_CANARY", "0") != "0"
+    ):
+        from predictionio_tpu.serving.canary import CanaryController
+
+        variant = load_variant(args)
+        engine_id, engine_version, engine_variant = engine_identity(variant)
+        canary = CanaryController(
+            router, fleet=fleet, storage=_storage(),
+            engine_id=engine_id, engine_version=engine_version,
+            engine_variant=engine_variant,
+        )
+        router.attach_canary(canary)
     fleet.start()
     if scaler is not None:
         scaler.start()
+    if canary is not None:
+        # finish whatever a killed predecessor left mid-flight (and
+        # fence it, should it still be alive somewhere)
+        resumed = canary.resume()
+        if resumed:
+            print(f"[INFO] Canary journal recovered: {resumed}.")
     port = router.start(args.ip, args.port)
     _install_drain_handler(router)
     print(
@@ -431,6 +452,8 @@ def _deploy_fleet(args) -> int:
         f"{ports[0]}-{ports[-1]}) is deploying behind the router at "
         f"http://{args.ip}:{port}. Roll with `pio fleet roll`."
         + (" Autoscaler is active." if scaler is not None else "")
+        + (" Canary controller is armed (`pio canary status`)."
+           if canary is not None else "")
     )
     try:
         router.service.serve_forever()
@@ -513,6 +536,66 @@ def cmd_fleet(args) -> int:
                 return 0
             _time.sleep(0.5)
         return _die(f"roll still in progress after {args.timeout}s")
+    except urllib.error.HTTPError as e:
+        return _die(f"router answered {e.code}: {e.read().decode()}")
+    except OSError as e:
+        return _die(f"no router at {base}: {e}")
+
+
+def cmd_canary(args) -> int:
+    """Operate a fleet router's canary controller: ``status`` prints the
+    state machine + verdict inputs; ``start`` begins a canary (newest
+    non-quarantined candidate, or ``--instance``); ``promote`` skips the
+    rest of the window; ``abort`` rolls back WITHOUT quarantining;
+    ``quarantine`` lists receipts (``--release ID`` clears one)."""
+    import urllib.error
+    import urllib.request
+
+    base = f"http://{args.ip}:{args.port}"
+
+    def call(path: str, method: str = "GET", payload: Optional[dict] = None):
+        data = json.dumps(payload).encode("utf-8") if payload else b""
+        req = urllib.request.Request(
+            base + path, method=method,
+            data=data if method == "POST" else None,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    try:
+        cmd = args.canary_command
+        if cmd == "status":
+            print(json.dumps(call("/canary"), indent=2))
+            return 0
+        if cmd == "start":
+            payload = {}
+            if getattr(args, "instance", None):
+                payload["instanceId"] = args.instance
+            if getattr(args, "force", False):
+                payload["force"] = True
+            out = call("/canary/start", "POST", payload)
+            print(json.dumps(out, indent=2))
+            print("[INFO] Canary started; watch `pio canary status`.")
+            return 0
+        if cmd == "promote":
+            print(json.dumps(call("/canary/promote", "POST"), indent=2))
+            return 0
+        if cmd == "abort":
+            print(json.dumps(call("/canary/abort", "POST"), indent=2))
+            return 0
+        # quarantine
+        if getattr(args, "release", None):
+            out = call(
+                "/canary/quarantine/release", "POST",
+                {"instanceId": args.release},
+            )
+            print(json.dumps(out, indent=2))
+            return 0 if out.get("released") else _die(
+                f"no quarantine receipt for {args.release}"
+            )
+        print(json.dumps(call("/canary/quarantine"), indent=2))
+        return 0
     except urllib.error.HTTPError as e:
         return _die(f"router answered {e.code}: {e.read().decode()}")
     except OSError as e:
@@ -1396,6 +1479,14 @@ def build_parser() -> argparse.ArgumentParser:
         "and thresholds); equivalent to PIO_AUTOSCALE=1",
     )
     sp.add_argument(
+        "--canary", action="store_true",
+        help="with --fleet: arm the canary controller — `pio canary "
+        "start` then rolls ONE replica to a candidate generation, "
+        "verifies it against SLOs under real traffic, and promotes or "
+        "auto-rolls-back (quarantining the bad generation); equivalent "
+        "to PIO_CANARY=1",
+    )
+    sp.add_argument(
         "--tenants", default=None, metavar="PATH_OR_JSON",
         help="tenant registry config (JSON file or inline): per-tenant "
         "access keys, quotas, SLOs, weights, A/B variants; equivalent "
@@ -1461,6 +1552,38 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--timeout", type=float, default=600.0,
                    help="seconds to wait for the roll to finish")
     x.set_defaults(func=cmd_fleet)
+
+    sp = sub.add_parser(
+        "canary", help="operate a fleet router's SLO-guarded canary "
+        "rollout (status / start / promote / abort / quarantine)"
+    )
+    canary_sub = sp.add_subparsers(dest="canary_command", required=True)
+    for verb, help_text in (
+        ("status", "print the canary state machine and verdict inputs"),
+        ("start", "canary ONE replica onto a candidate generation"),
+        ("promote", "skip the rest of the verification window"),
+        ("abort", "roll the canary back WITHOUT quarantining"),
+        ("quarantine", "list quarantine receipts (--release ID clears)"),
+    ):
+        x = canary_sub.add_parser(verb, help=help_text)
+        x.add_argument("--ip", default="127.0.0.1")
+        x.add_argument("--port", type=int, default=8000)
+        if verb == "start":
+            x.add_argument(
+                "--instance", default=None,
+                help="candidate engine instance id (default: newest "
+                "non-quarantined COMPLETED generation)",
+            )
+            x.add_argument(
+                "--force", action="store_true",
+                help="canary a quarantined candidate anyway",
+            )
+        if verb == "quarantine":
+            x.add_argument(
+                "--release", default=None, metavar="INSTANCE_ID",
+                help="clear the receipt for this instance id",
+            )
+        x.set_defaults(func=cmd_canary)
 
     sp = sub.add_parser(
         "shards", help="inspect or rebuild a published model's sharded-"

@@ -153,6 +153,19 @@ def summarize_metrics(series: dict) -> dict:
             out.setdefault("breakerStates", {})[
                 ",".join(f"{k}={val}" for k, val in labels)
             ] = v
+    # progressive delivery (ISSUE 20): pio_canary_info exists only behind
+    # a canary-armed router; its labels say whether this run's traffic hit
+    # a fleet mid-canary, and the quarantine gauge says whether any model
+    # generation is blocked from deployment right now
+    for (name, labels), v in series.items():
+        if name == "pio_canary_info" and v:
+            lbl = dict(labels)
+            out["canaryState"] = lbl.get("state", "")
+            out["canaryGeneration"] = lbl.get("candidate", "")
+    if latest("pio_canary_quarantined_generations") is not None:
+        out["quarantinedGenerations"] = latest(
+            "pio_canary_quarantined_generations"
+        )
     return out
 
 

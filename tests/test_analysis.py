@@ -531,6 +531,61 @@ def test_blocking_delta_worker_loops(tmp_path):
     assert syms == {"_delta_loop.sleep", "_delta_loop.dumps"}
 
 
+CANARY_LOOP_FIXTURE = {
+    # the canary controller's verification window and post-promotion
+    # soak watchdog are hot-loop names: pacing belongs on Event.wait,
+    # every blocking step (HTTP probes, journal I/O) in tick helpers
+    "serving/canary_bad.py": """\
+        import json
+        import time
+
+        class Controller:
+            def _verify_loop(self):
+                time.sleep(0.25)
+                return json.dumps({"state": "verifying"})
+
+            def _soak_loop(self):
+                time.sleep(0.25)
+    """,
+    "serving/canary_good.py": """\
+        class Controller:
+            def _verify_loop(self):
+                # repo idiom: pace on the sanctioned Event.wait and
+                # delegate the tick — must stay clean
+                while not self._stop_evt.wait(self.tick_s):
+                    if self._verify_tick():
+                        return
+
+            def _soak_loop(self):
+                while not self._stop_evt.wait(self.tick_s):
+                    if self._soak_tick():
+                        return
+
+            def _verify_tick(self):
+                # delegated helper: not a hot-loop name, out of scope
+                return True
+
+            def _soak_tick(self):
+                return True
+    """,
+    "core/canary_elsewhere.py": """\
+        import time
+
+        class Controller:
+            def _verify_loop(self):
+                time.sleep(0.25)  # not serving//data/api: out of scope
+    """,
+}
+
+
+def test_blocking_canary_controller_loops(tmp_path):
+    root = make_repo(tmp_path, CANARY_LOOP_FIXTURE)
+    rep = run(root, analyzers=["blocking"])
+    syms = symbols(rep, "blocking-call-in-hot-loop")
+    assert syms == {"_verify_loop.sleep", "_verify_loop.dumps",
+                    "_soak_loop.sleep"}
+
+
 # -- lockorder ----------------------------------------------------------------
 
 
@@ -731,6 +786,35 @@ def test_deadline_delta_plane_entry_points(tmp_path):
     rep = run(root, analyzers=["deadline"])
     drops = symbols(rep, "deadline-drop")
     assert drops == {"push_delta", "catchup_from_log"}
+
+
+CANARY_SHADOW_DEADLINE_FIXTURE = {
+    # the canary's shadow-mirror hop replays captured queries to
+    # candidate + baseline; it is a "serve" request verb and must carry
+    # the remaining budget downstream like any other hop
+    "serving/canary_shadow.py": """\
+        import urllib.request
+
+        def _serve_shadow_pair(body, url):
+            # repo idiom: a fresh per-mirror deadline, remaining budget
+            # forwarded on the wire — must stay clean
+            deadline = Deadline.after_ms(1000.0)
+            headers = {}
+            headers[DEADLINE_HEADER] = f"{deadline.remaining_ms():.0f}"
+            return urllib.request.urlopen(url, timeout=1)
+
+        def serve_shadow_dropped(body, url):
+            # mirrored hop with no deadline contract: must flag
+            return urllib.request.urlopen(url, timeout=1)
+    """,
+}
+
+
+def test_deadline_canary_shadow_hop(tmp_path):
+    root = make_repo(tmp_path, CANARY_SHADOW_DEADLINE_FIXTURE)
+    rep = run(root, analyzers=["deadline"])
+    assert symbols(rep, "deadline-drop") == {"serve_shadow_dropped"}
+    assert not any(f.symbol == "_serve_shadow_pair" for f in rep.findings)
 
 
 # -- collective ---------------------------------------------------------------

@@ -35,7 +35,8 @@ _LEAN = {"BENCH_SERVING": "0", "BENCH_SOLVER_AB": "0", "BENCH_MEASURED": "0",
          "BENCH_INGEST": "0", "BENCH_OBS": "0", "BENCH_DURABILITY": "0",
          "BENCH_KERNEL": "0", "BENCH_TRAIN_KERNEL": "0", "BENCH_FLEET": "0",
          "BENCH_ELASTIC": "0", "BENCH_SHARDED": "0", "BENCH_RETRIEVAL": "0",
-         "BENCH_FRESHNESS": "0", "BENCH_POD": "0", "BENCH_TENANT": "0"}
+         "BENCH_FRESHNESS": "0", "BENCH_POD": "0", "BENCH_TENANT": "0",
+         "BENCH_CANARY": "0"}
 
 # (cell name, env overrides) — primary first
 CELLS = [
@@ -360,6 +361,32 @@ def main() -> int:
         "pipeline_gate": ten_pipe.get("gate_pass"),
         "gate_pass": ten.get("gate_pass"),
     }
+    # canary gate (ISSUE 20): a deliberately bad candidate generation
+    # canaried under load must be detected and auto-rolled-back with ZERO
+    # client-visible errors, a blast radius no bigger than the canary
+    # fraction (1/3 + slack for routing jitter), and a durable quarantine
+    # receipt that survives restart (newest-COMPLETED selection resolves
+    # the baseline) and refuses a re-deploy of the same generation
+    cnr = primary.get("canary") or {}
+    blast = cnr.get("blast_radius")
+    artifact["canary"] = {
+        "rolled_back": cnr.get("rolled_back"),
+        "rollback_reason": cnr.get("rollback_reason"),
+        "client_errors": cnr.get("client_errors"),
+        "client_ok": cnr.get("client_ok"),
+        "blast_radius": blast,
+        "candidate_p99_ms": cnr.get("candidate_p99_ms"),
+        "shadow_pairs": cnr.get("shadow_pairs"),
+        "receipt_on_disk": cnr.get("receipt_on_disk"),
+        "receipt_blocks_redeploy": cnr.get("receipt_blocks_redeploy"),
+        "gate_pass": (
+            cnr.get("rolled_back") is True
+            and cnr.get("client_errors") == 0
+            and isinstance(blast, (int, float)) and blast <= 0.5
+            and cnr.get("receipt_on_disk") is True
+            and cnr.get("receipt_blocks_redeploy") is True
+        ),
+    }
     # static-analysis gate: perf numbers from a repo carrying hot-path or
     # race hazards are not publishable — `pio analyze` must report zero
     # errors for the matrix to count
@@ -417,6 +444,7 @@ def main() -> int:
         "fleet": artifact["fleet"],
         "multichip": artifact["multichip"],
         "tenant": artifact["tenant"],
+        "canary": artifact["canary"],
         "analysis": artifact["analysis"],
     }))
     return 0 if all_tpu else 1
